@@ -1,0 +1,21 @@
+"""llama_pipeline_parallel_tpu — a TPU-native LLaMA pipeline-parallel training framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+SparkJiao/llama-pipeline-parallel (DeepSpeed pipeline-parallel LLaMA fine-tuning):
+
+- hybrid pipeline x data x tensor x sequence parallelism over a `jax.sharding.Mesh`
+  (reference: DeepSpeed PipelineModule grid, trainer_base_ds_mp.py:425-429)
+- microbatched pipeline schedule inside a single jitted step, with stage handoff via
+  `jax.lax.ppermute` over the ICI `pp` axis (reference: engine.train_batch,
+  trainer_base_ds_mp.py:354)
+- ZeRO-1-style optimizer-state sharding + host-offload tier (reference:
+  conf yaml zero_optimization/offload blocks)
+- Orbax checkpointing with a layer->stage manifest and an HF converter
+  (reference: convert2ckpt.py)
+- FLAN-style data pipeline with the engine tuple protocol, fixed (reference:
+  data/flan.py)
+"""
+
+__version__ = "0.1.0"
+
+from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: F401

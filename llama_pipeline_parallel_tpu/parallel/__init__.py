@@ -1,0 +1,8 @@
+from llama_pipeline_parallel_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_DP,
+    AXIS_PP,
+    AXIS_SP,
+    AXIS_TP,
+    MeshConfig,
+    make_mesh,
+)

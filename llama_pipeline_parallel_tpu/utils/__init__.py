@@ -1,0 +1,1 @@
+from llama_pipeline_parallel_tpu.utils.logging import get_logger  # noqa: F401

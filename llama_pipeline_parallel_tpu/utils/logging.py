@@ -1,0 +1,40 @@
+"""Process-aware logging.
+
+Re-specifies the absent `general_util.logger.get_child_logger` the reference
+imports (reference data/data_utils.py:9 — module missing from the extract).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s %(levelname).1s [%(name)s] %(message)s"
+_configured = False
+
+
+def _configure_root() -> None:
+    global _configured
+    if _configured:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%H:%M:%S"))
+    root = logging.getLogger("llama_pipeline_parallel_tpu")
+    root.addHandler(handler)
+    root.setLevel(os.environ.get("LPP_TPU_LOG_LEVEL", "INFO").upper())
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    _configure_root()
+    if not name.startswith("llama_pipeline_parallel_tpu"):
+        name = f"llama_pipeline_parallel_tpu.{name}"
+    return logging.getLogger(name)
+
+
+def is_main_process() -> bool:
+    import jax
+
+    return jax.process_index() == 0

@@ -1,0 +1,47 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from llama_pipeline_parallel_tpu.parallel import mesh as mesh_lib
+from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
+
+
+def test_mesh_shapes(devices):
+    m = make_mesh(MeshConfig(pp=4, dp=2))
+    assert m.shape == {"pp": 4, "dp": 2, "sp": 1, "tp": 1}
+    m2 = make_mesh(MeshConfig(pp=2, dp=2, tp=2))
+    assert m2.shape["tp"] == 2
+
+
+def test_from_world():
+    cfg = MeshConfig.from_world(8, pp=4)
+    assert cfg.dp == 2 and cfg.world_size == 8
+    with pytest.raises(ValueError):
+        MeshConfig.from_world(6, pp=4)
+
+
+def test_too_many_devices(devices):
+    with pytest.raises(ValueError):
+        make_mesh(MeshConfig(pp=16))
+
+
+def test_stage_index_inside_shard_map(devices):
+    m = make_mesh(MeshConfig(pp=4, dp=2))
+
+    def f():
+        return (
+            mesh_lib.stage_index()[None],
+            mesh_lib.dp_index()[None],
+            mesh_lib.is_last_stage()[None],
+        )
+
+    sm = shard_map(
+        f, mesh=m, in_specs=(), out_specs=(P("pp"), P("dp"), P("pp")), check_vma=False
+    )
+    stages, dps, last = jax.jit(sm)()
+    np.testing.assert_array_equal(np.asarray(stages), [0, 1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(dps), [0, 1])
+    np.testing.assert_array_equal(np.asarray(last), [False, False, False, True])
