@@ -1,0 +1,1 @@
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig  # noqa: F401

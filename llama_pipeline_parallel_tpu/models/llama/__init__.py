@@ -1,0 +1,6 @@
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig  # noqa: F401
+from llama_pipeline_parallel_tpu.models.llama.model import (  # noqa: F401
+    forward,
+    init_params,
+    loss_fn,
+)

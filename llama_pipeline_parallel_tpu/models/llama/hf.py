@@ -1,0 +1,91 @@
+"""HF <-> native parameter-tree conversion.
+
+The in-memory half of the converter (reference convert2ckpt.py:19-48 walks an
+HF `LlamaForCausalLM` state_dict into per-layer DeepSpeed files). Here the HF
+state_dict maps into the stacked pytree of model.py; tools/convert_hf.py wraps
+this with checkpoint I/O.
+
+torch Linear stores weights [out, in] and computes y = x @ W.T; our matmuls are
+y = x @ W with W [in, out], so every projection transposes on import.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+
+
+def _np(t: Any) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor
+        return t.detach().to("cpu").float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def params_from_hf_state_dict(sd: Mapping[str, Any], cfg: LlamaConfig) -> dict:
+    """Build the stacked params pytree from an HF LlamaForCausalLM state_dict."""
+    n = cfg.num_hidden_layers
+
+    def layer_stack(fmt: str, transpose: bool) -> np.ndarray:
+        mats = []
+        for i in range(n):
+            w = _np(sd[fmt.format(i=i)])
+            mats.append(w.T if transpose else w)
+        return np.stack(mats)
+
+    params = {
+        "embed": {"embedding": _np(sd["model.embed_tokens.weight"])},
+        "layers": {
+            "attn": {
+                "wq": layer_stack("model.layers.{i}.self_attn.q_proj.weight", True),
+                "wk": layer_stack("model.layers.{i}.self_attn.k_proj.weight", True),
+                "wv": layer_stack("model.layers.{i}.self_attn.v_proj.weight", True),
+                "wo": layer_stack("model.layers.{i}.self_attn.o_proj.weight", True),
+            },
+            "mlp": {
+                "gate": layer_stack("model.layers.{i}.mlp.gate_proj.weight", True),
+                "up": layer_stack("model.layers.{i}.mlp.up_proj.weight", True),
+                "down": layer_stack("model.layers.{i}.mlp.down_proj.weight", True),
+            },
+            "input_norm": layer_stack("model.layers.{i}.input_layernorm.weight", False),
+            "post_norm": layer_stack("model.layers.{i}.post_attention_layernorm.weight", False),
+        },
+        "norm": _np(sd["model.norm.weight"]),
+    }
+    if cfg.tie_word_embeddings:
+        params["lm_head"] = params["embed"]["embedding"].T.copy()
+    elif "lm_head.weight" not in sd:
+        raise KeyError(
+            "state_dict has no 'lm_head.weight' but tie_word_embeddings=False; "
+            "refusing to silently tie (LLaMA must not tie, reference README.md:44-46)")
+    else:
+        params["lm_head"] = _np(sd["lm_head.weight"]).T.copy()
+    return params
+
+
+def hf_state_dict_from_params(params: dict, cfg: LlamaConfig) -> dict[str, np.ndarray]:
+    """Inverse mapping (native -> HF names), for round-trip export/tests."""
+    out: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"]["embedding"], np.float32),
+        "model.norm.weight": np.asarray(params["norm"], np.float32),
+        "lm_head.weight": np.asarray(params["lm_head"], np.float32).T.copy(),
+    }
+    layers = params["layers"]
+    names = {
+        "self_attn.q_proj.weight": (layers["attn"]["wq"], True),
+        "self_attn.k_proj.weight": (layers["attn"]["wk"], True),
+        "self_attn.v_proj.weight": (layers["attn"]["wv"], True),
+        "self_attn.o_proj.weight": (layers["attn"]["wo"], True),
+        "mlp.gate_proj.weight": (layers["mlp"]["gate"], True),
+        "mlp.up_proj.weight": (layers["mlp"]["up"], True),
+        "mlp.down_proj.weight": (layers["mlp"]["down"], True),
+        "input_layernorm.weight": (layers["input_norm"], False),
+        "post_attention_layernorm.weight": (layers["post_norm"], False),
+    }
+    for i in range(cfg.num_hidden_layers):
+        for suffix, (stacked, transpose) in names.items():
+            w = np.asarray(stacked[i], np.float32)
+            out[f"model.layers.{i}.{suffix}"] = w.T.copy() if transpose else w
+    return out

@@ -1,0 +1,67 @@
+"""Layer -> pipeline-stage manifest.
+
+The reference encodes the stage partition twice, implicitly: once as layer-list
+order (models/llama_ds_mp_wrap.py:213-219) and once as checkpoint filename
+arithmetic (convert2ckpt.py:24-36, `layer_{i+1:02d}-model_00-...`), and the
+two must stay in lockstep by convention. Here the mapping is one explicit,
+serializable object that both the pipeline runtime and the checkpoint engine
+consume — which is also what makes PP-topology-changing restores possible
+(SURVEY.md §7.3 item 5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class StageManifest:
+    num_layers: int
+    num_stages: int
+
+    def __post_init__(self) -> None:
+        if self.num_stages < 1:
+            raise ValueError(f"num_stages must be >= 1, got {self.num_stages}")
+        if self.num_layers % self.num_stages:
+            raise ValueError(
+                f"num_layers={self.num_layers} not divisible by "
+                f"num_stages={self.num_stages}; uneven stage partitions are not "
+                f"supported yet (cost-balanced partitioning is a planned knob)"
+            )
+
+    @property
+    def layers_per_stage(self) -> int:
+        return self.num_layers // self.num_stages
+
+    # embed lives on the first stage, final norm + lm head on the last
+    # (reference layer-list order, models/llama_ds_mp_wrap.py:213-219)
+    embed_stage: int = 0
+
+    @property
+    def head_stage(self) -> int:
+        return self.num_stages - 1
+
+    def stage_of_layer(self, layer_idx: int) -> int:
+        if not 0 <= layer_idx < self.num_layers:
+            raise ValueError(f"layer {layer_idx} out of range [0, {self.num_layers})")
+        return layer_idx // self.layers_per_stage
+
+    def layers_of_stage(self, stage: int) -> range:
+        if not 0 <= stage < self.num_stages:
+            raise ValueError(f"stage {stage} out of range [0, {self.num_stages})")
+        k = self.layers_per_stage
+        return range(stage * k, (stage + 1) * k)
+
+    @staticmethod
+    def for_config(cfg: LlamaConfig, num_stages: int) -> "StageManifest":
+        return StageManifest(num_layers=cfg.num_hidden_layers, num_stages=num_stages)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @staticmethod
+    def from_json(s: str) -> "StageManifest":
+        return StageManifest(**json.loads(s))

@@ -1,0 +1,218 @@
+"""Pure-functional LLaMA with stacked layer parameters.
+
+Design notes (vs the reference):
+- The reference cuts an HF `LlamaForCausalLM` into a flat list of DeepSpeed
+  `LayerSpec`s (reference models/llama_ds_mp_wrap.py:209-224: EmbeddingPipe,
+  k x ParallelTransformerLayerPipe, LayerNormPipe, LMLayerPipe). Here the same
+  partition exists as *data layout*: all decoder layers share one pytree whose
+  leaves carry a leading `num_hidden_layers` axis. A single-device forward
+  `lax.scan`s over that axis; the pipeline runtime reshapes it to
+  `[num_stages, layers_per_stage, ...]` and shards the stage axis over the
+  `pp` mesh axis (see parallel/pipeline.py). No per-layer Python objects, no
+  filename arithmetic.
+- Embedding / final norm / lm-head are separate top-level entries, placed on
+  the first/last stage by the pipeline runtime (reference stage predicates
+  trainer_base_ds_mp.py:309).
+- No weight tying between embed and lm_head (reference README.md:44-46).
+- Params are kept in `param_dtype` (fp32 master) and cast to `dtype` (bf16)
+  at forward entry — the bf16 analogue of DeepSpeed's fp16 master-weight
+  machinery (reference conf yaml fp16 block), with no loss scaling needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+from llama_pipeline_parallel_tpu.ops.attention import attention
+from llama_pipeline_parallel_tpu.ops.rmsnorm import rms_norm
+from llama_pipeline_parallel_tpu.ops.rope import apply_rope, rope_cos_sin
+
+Params = dict
+AttnFn = Callable[..., jnp.ndarray]
+
+IGNORE_INDEX = -100  # label value excluded from the loss (reference data/flan.py:187)
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
+    """Random init (normal 0.02, HF default) with stacked layer leaves."""
+    n, d, f, v = (cfg.num_hidden_layers, cfg.hidden_size,
+                  cfg.intermediate_size, cfg.vocab_size)
+    kv_dim = cfg.kv_heads * cfg.head_dim
+    keys = jax.random.split(rng, 9)
+    pd = cfg.param_dtype
+
+    def nrm(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * 0.02).astype(pd)
+
+    return {
+        "embed": {"embedding": nrm(keys[0], (v, d))},
+        "layers": {
+            "attn": {
+                "wq": nrm(keys[1], (n, d, d)),
+                "wk": nrm(keys[2], (n, d, kv_dim)),
+                "wv": nrm(keys[3], (n, d, kv_dim)),
+                "wo": nrm(keys[4], (n, d, d)),
+            },
+            "mlp": {
+                "gate": nrm(keys[5], (n, d, f)),
+                "up": nrm(keys[6], (n, d, f)),
+                "down": nrm(keys[7], (n, f, d)),
+            },
+            "input_norm": jnp.ones((n, d), pd),
+            "post_norm": jnp.ones((n, d), pd),
+        },
+        "norm": jnp.ones((d,), pd),
+        "lm_head": nrm(keys[8], (d, v)),
+    }
+
+
+def cast_params(params: Params, dtype) -> Params:
+    return jax.tree.map(lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                        params)
+
+
+# ---------------------------------------------------------------------------
+# Forward pieces (each maps onto one reference pipe-layer class)
+# ---------------------------------------------------------------------------
+
+def embed(params: Params, input_ids: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
+    """Token embedding (reference EmbeddingPipe, models/llama_ds_mp_wrap.py:128-132)."""
+    return params["embed"]["embedding"].astype(cfg.dtype)[input_ids]
+
+
+def decoder_layer(
+    layer: Params,
+    x: jnp.ndarray,
+    padding_mask: jnp.ndarray | None,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    cfg: LlamaConfig,
+    attn_fn: AttnFn = attention,
+) -> jnp.ndarray:
+    """One transformer block (reference ParallelTransformerLayerPipe,
+    models/llama_ds_mp_wrap.py:135-181, which wraps HF LlamaDecoderLayer)."""
+    b, s, d = x.shape
+    h, kv, hd = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    residual = x
+    hidden = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+    q = (hidden @ layer["attn"]["wq"].astype(dt)).reshape(b, s, h, hd)
+    k = (hidden @ layer["attn"]["wk"].astype(dt)).reshape(b, s, kv, hd)
+    v = (hidden @ layer["attn"]["wv"].astype(dt)).reshape(b, s, kv, hd)
+    q, k = apply_rope(q, k, cos, sin)
+    attn_out = attn_fn(q, k, v, padding_mask, causal=True)
+    attn_out = attn_out.reshape(b, s, d) @ layer["attn"]["wo"].astype(dt)
+    x = residual + attn_out
+
+    residual = x
+    hidden = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
+    gate = jax.nn.silu(hidden @ layer["mlp"]["gate"].astype(dt))
+    up = hidden @ layer["mlp"]["up"].astype(dt)
+    x = residual + (gate * up) @ layer["mlp"]["down"].astype(dt)
+    return x
+
+
+def run_layers(
+    layers: Params,
+    x: jnp.ndarray,
+    padding_mask: jnp.ndarray | None,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    cfg: LlamaConfig,
+    attn_fn: AttnFn = attention,
+    remat: bool = False,
+) -> jnp.ndarray:
+    """Apply a stack of layers (leading axis on every leaf) via lax.scan.
+
+    `remat=True` recomputes each layer in backward — the analogue of
+    `deepspeed.checkpointing.checkpoint` per layer (reference
+    models/llama_ds_mp_wrap.py:57,166; flag conf yaml `activation_checkpointing`).
+    """
+
+    def body(h, layer):
+        return decoder_layer(layer, h, padding_mask, cos, sin, cfg, attn_fn), None
+
+    if remat:
+        body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+    x, _ = jax.lax.scan(body, x, layers)
+    return x
+
+
+def final_norm(params: Params, x: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
+    """Final RMSNorm (reference LayerNormPipe, models/llama_ds_mp_wrap.py:184-188)."""
+    return rms_norm(x, params["norm"], cfg.rms_norm_eps)
+
+
+def lm_head(params: Params, x: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
+    """Logits projection (reference LMLayerPipe, models/llama_ds_mp_wrap.py:191-195).
+    Returns fp32 logits for a stable softmax-CE."""
+    return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+def forward(
+    params: Params,
+    input_ids: jnp.ndarray,
+    attention_mask: jnp.ndarray | None = None,
+    position_ids: jnp.ndarray | None = None,
+    *,
+    cfg: LlamaConfig,
+    attn_fn: AttnFn = attention,
+    remat: bool = False,
+) -> jnp.ndarray:
+    """Single-device full forward: the PP=1 degenerate schedule.
+
+    Batch protocol matches the reference collator output
+    `(input_ids, attention_mask, position_ids)` (reference data/flan.py:304-307)
+    with `attention_mask` as a per-token [b, s] 0/1 mask, NOT a materialized
+    [b, 1, L, L] tensor (SURVEY.md §3.5 fix).
+    """
+    b, s = input_ids.shape
+    if position_ids is None:
+        position_ids = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    cos, sin = rope_cos_sin(position_ids, cfg.head_dim, cfg.rope_theta, dtype=cfg.dtype)
+    x = embed(params, input_ids, cfg)
+    x = run_layers(params["layers"], x, attention_mask, cos, sin, cfg, attn_fn, remat)
+    x = final_norm(params, x, cfg)
+    return lm_head(params, x, cfg)
+
+
+# ---------------------------------------------------------------------------
+# Loss
+# ---------------------------------------------------------------------------
+
+def token_loss_sum_and_count(logits: jnp.ndarray, labels: jnp.ndarray
+                             ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Shifted causal-LM cross-entropy: (sum of token losses, valid-token count).
+
+    The single source of truth for shift/IGNORE_INDEX masking semantics —
+    both the single-device loss below and the pipeline's last-stage loss
+    (parallel/pipeline.py) build on it, so they cannot drift apart.
+    """
+    shift_logits = logits[:, :-1, :]
+    shift_labels = labels[:, 1:]
+    valid = shift_labels != IGNORE_INDEX
+    safe_labels = jnp.where(valid, shift_labels, 0)
+    logp = jax.nn.log_softmax(shift_logits.astype(jnp.float32), axis=-1)
+    token_ll = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    loss_sum = jnp.where(valid, -token_ll, 0.0).sum()
+    return loss_sum, valid.sum()
+
+
+def loss_fn(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Token-mean shifted cross-entropy with IGNORE_INDEX masking.
+
+    Mirrors the reference `loss_fn` (models/llama_ds_mp_wrap.py:105-116) minus
+    its index-column bug (labels there carried a smuggled extra column,
+    SURVEY.md §3.5): labels here are exactly [b, s].
+    """
+    loss_sum, count = token_loss_sum_and_count(logits, labels)
+    return loss_sum / jnp.maximum(count, 1)

@@ -1,0 +1,3 @@
+from llama_pipeline_parallel_tpu.ops.rmsnorm import rms_norm  # noqa: F401
+from llama_pipeline_parallel_tpu.ops.rope import apply_rope, rope_cos_sin  # noqa: F401
+from llama_pipeline_parallel_tpu.ops.attention import attention  # noqa: F401

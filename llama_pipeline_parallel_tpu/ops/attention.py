@@ -1,0 +1,67 @@
+"""Attention ops.
+
+The reference materializes a full `[bsz, 1, L, L]` fp16 additive causal mask in
+the data collator (reference data/flan.py:194-243) — O(L^2) host memory and a
+hard blocker for long contexts (SURVEY.md §5.7). Here the mask never exists as
+data: the causal predicate and the padding mask are fused into the attention op
+itself, and the flash path (Pallas) evaluates the predicate in-kernel.
+
+`attention` is the XLA reference path: exact softmax attention with causal +
+padding masking built from an iota comparison at trace time.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[b, s, kv_heads, hd] -> [b, s, kv_heads * n_rep, hd] (GQA expansion)."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    padding_mask: jnp.ndarray | None = None,
+    *,
+    causal: bool = True,
+    q_offset: jnp.ndarray | int = 0,
+    kv_offset: jnp.ndarray | int = 0,
+) -> jnp.ndarray:
+    """Exact attention. q: [b, sq, h, hd]; k/v: [b, skv, h_kv, hd].
+
+    padding_mask: [b, skv] with 1 = real token, 0 = pad (the collator's 1-D
+    mask — never a materialized [L, L] tensor).
+    q_offset/kv_offset: global positions of the local q/kv blocks, used by the
+    ring-attention caller where each sp shard holds a sequence slice.
+    """
+    b, sq, h, hd = q.shape
+    n_rep = h // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+
+    scale = hd ** -0.5
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    # [b, h, sq, skv]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
+
+    if causal:
+        q_pos = q_offset + jnp.arange(sq)
+        kv_pos = kv_offset + jnp.arange(k.shape[1])
+        causal_ok = q_pos[:, None] >= kv_pos[None, :]  # [sq, skv]
+        scores = jnp.where(causal_ok[None, None], scores, NEG_INF)
+    if padding_mask is not None:
+        scores = jnp.where(padding_mask[:, None, None, :].astype(bool), scores, NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)

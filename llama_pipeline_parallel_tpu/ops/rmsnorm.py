@@ -1,0 +1,21 @@
+"""RMSNorm.
+
+Matches `transformers.models.llama.LlamaRMSNorm` numerics (the reference keeps
+HF's layer at models/llama_ds_mp_wrap.py:8-13): variance in fp32, scale applied
+in the input dtype. XLA fuses this into neighbouring ops; a Pallas fused
+variant only pays off when folded into attention/matmul prologues, so the jnp
+form is the canonical one.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    variance = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    xf = xf * jax.lax.rsqrt(variance + eps)
+    return (weight.astype(jnp.float32) * xf).astype(dtype)

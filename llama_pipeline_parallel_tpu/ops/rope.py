@@ -1,0 +1,40 @@
+"""Rotary position embeddings, HF-LLaMA `rotate_half` convention.
+
+Numerics match `transformers.models.llama.modeling_llama.apply_rotary_pos_emb`
+so HF checkpoints load bit-compatibly (reference uses HF's attention unchanged,
+models/llama_ds_mp_wrap.py:8-13).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(position_ids: jnp.ndarray, head_dim: int, theta: float = 10000.0,
+                 dtype=jnp.float32) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for the given positions.
+
+    position_ids: [batch, seq] int32 -> cos, sin: [batch, seq, head_dim]
+    """
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    freqs = position_ids.astype(jnp.float32)[..., None] * inv_freq  # [b, s, hd/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [b, s, hd]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rope(q: jnp.ndarray, k: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+               ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Apply rotary embedding.
+
+    q: [b, s, n_heads, hd], k: [b, s, n_kv_heads, hd], cos/sin: [b, s, hd].
+    """
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+    q_rot = q * cos + _rotate_half(q) * sin
+    k_rot = k * cos + _rotate_half(k) * sin
+    return q_rot.astype(q.dtype), k_rot.astype(k.dtype)

@@ -24,7 +24,7 @@ from typing import Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from llama_pipeline_parallel_tpu.utils.logging import get_logger
 
@@ -104,28 +104,6 @@ def make_mesh(config: MeshConfig, devices: Sequence[jax.Device] | None = None) -
     else:
         dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, ALL_AXES)
-
-
-def single_device_mesh() -> Mesh:
-    return make_mesh(MeshConfig(), devices=jax.devices()[:1])
-
-
-# ---------------------------------------------------------------------------
-# PartitionSpec helpers
-# ---------------------------------------------------------------------------
-
-def batch_spec() -> P:
-    """Global batch layout: batch dim sharded over dp, sequence over sp."""
-    return P(AXIS_DP, AXIS_SP)
-
-
-def stage_stacked_spec(*rest: str | None) -> P:
-    """Spec for a parameter stacked over pipeline stages on its leading dim."""
-    return P(AXIS_PP, *rest)
-
-
-def named(mesh: Mesh, spec: P) -> NamedSharding:
-    return NamedSharding(mesh, spec)
 
 
 # ---------------------------------------------------------------------------
