@@ -1,0 +1,4 @@
+from llama_pipeline_parallel_tpu.ckpt.checkpoint import (  # noqa: F401
+    CheckpointManager,
+    find_resume_checkpoint,
+)

@@ -1,0 +1,180 @@
+"""Checkpoint save/load/resume on Orbax.
+
+Replaces the reference's three cooperating mechanisms (SURVEY.md §5.4):
+`engine.save_checkpoint` layer files + `latest` tag (reference
+trainer_base_ds_mp.py:205, convert2ckpt.py:76-77), the module-only warm start
+with its monkey-patched loader (trainer_base_ds_mp.py:49-121 — patched
+upstream bug: stock load insisted on optimizer state), and resume-step
+parsing from `checkpoint-N` dirnames (trainer_base_ds_mp.py:452-455).
+
+Design differences from the reference:
+- Canonical layout: params are stored with layer leaves `[num_layers, ...]`,
+  never `[num_stages, layers_per_stage, ...]`; the stage manifest is metadata,
+  not filename arithmetic. Any PP topology restores any checkpoint
+  (the reference forbids exactly this, SURVEY.md §7.3 item 5).
+- Params and optimizer state are separate Orbax items, so a module-only warm
+  start from a FULL training checkpoint needs no monkey-patch — it simply
+  doesn't open the optimizer item.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+from llama_pipeline_parallel_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+LATEST_TAG = "latest"  # tag-file name, as in the reference (convert2ckpt.py:76)
+_CKPT_RE = re.compile(r"^checkpoint-(\d+)$")
+
+
+def _canonicalize_moments(tree: Any, manifest: StageManifest, to_canonical: bool) -> Any:
+    """Unstack/stack any params-shaped subtrees inside the optimizer state."""
+    fn = pl.unstack_stages if to_canonical else pl.stack_stages
+
+    def walk(node):
+        if isinstance(node, dict) and "layers" in node and "embed" in node:
+            return fn(node, manifest)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            mapped = [walk(v) for v in node]
+            return type(node)(*mapped) if hasattr(node, "_fields") else type(node)(mapped)
+        return node
+
+    return walk(tree)
+
+
+def _abstract(tree: Any) -> Any:
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(
+            np.shape(x), np.asarray(x).dtype if np.isscalar(x) else x.dtype,
+            sharding=getattr(x, "sharding", None)),
+        tree)
+
+
+@dataclasses.dataclass
+class CheckpointManager:
+    """Layout: <root>/checkpoint-<step>/{params/, opt/, meta.json} + <root>/latest."""
+
+    root: str
+
+    def __post_init__(self) -> None:
+        os.makedirs(self.root, exist_ok=True)
+        self._ckptr = ocp.StandardCheckpointer()
+
+    # -- paths ------------------------------------------------------------
+
+    def step_dir(self, step: int) -> str:
+        return os.path.join(self.root, f"checkpoint-{step}")
+
+    def latest_step(self) -> int | None:
+        tag = os.path.join(self.root, LATEST_TAG)
+        if os.path.exists(tag):
+            with open(tag) as f:
+                name = f.read().strip()
+            m = _CKPT_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.root, name)):
+                return int(m.group(1))
+            logger.warning("stale latest tag %r; falling back to directory scan", name)
+        steps = [int(m.group(1)) for d in os.listdir(self.root)
+                 if (m := _CKPT_RE.match(d)) and os.path.isdir(os.path.join(self.root, d))]
+        return max(steps) if steps else None
+
+    # -- save -------------------------------------------------------------
+
+    def save(self, step: int, params_stacked: dict, manifest: StageManifest,
+             cfg: LlamaConfig, opt_state: Any | None = None) -> str:
+        """Save train state (canonical layout) + metadata, update `latest`.
+
+        `opt_state=None` produces a module-only checkpoint (the converter's
+        output — like reference convert2ckpt.py, which writes no optimizer
+        state either)."""
+        path = self.step_dir(step)
+        self._ckptr.save(os.path.join(path, "params"),
+                         pl.unstack_stages(params_stacked, manifest), force=True)
+        if opt_state is not None:
+            self._ckptr.save(os.path.join(path, "opt"),
+                             _canonicalize_moments(opt_state, manifest, to_canonical=True),
+                             force=True)
+        # StandardCheckpointer writes asynchronously; the tag/meta below must
+        # only appear once the array data is durably on disk.
+        self._ckptr.wait_until_finished()
+        meta = {
+            "step": step,
+            "manifest": dataclasses.asdict(manifest),
+            "model_config": _config_meta(cfg),
+            "has_optimizer_state": opt_state is not None,
+            "format_version": 1,
+        }
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+        with open(os.path.join(self.root, LATEST_TAG), "w") as f:
+            f.write(f"checkpoint-{step}")
+        logger.info("saved checkpoint-%d to %s", step, path)
+        return path
+
+    # -- load -------------------------------------------------------------
+
+    def load_meta(self, step: int) -> dict:
+        with open(os.path.join(self.step_dir(step), "meta.json")) as f:
+            return json.load(f)
+
+    def load_params(self, step: int, params_template_stacked: dict,
+                    manifest: StageManifest) -> dict:
+        """Module-only warm start (reference `load_module_only=True`,
+        trainer_base_ds_mp.py:284): restores params into the CURRENT
+        topology's stacked layout, regardless of the PP degree at save time."""
+        canonical = pl.unstack_stages(params_template_stacked, manifest)
+        restored = self._ckptr.restore(
+            os.path.join(self.step_dir(step), "params"), _abstract(canonical))
+        return pl.stack_stages(restored, manifest)
+
+    def load(self, step: int, params_template_stacked: dict, opt_template: Any,
+             manifest: StageManifest) -> tuple[dict, Any, int]:
+        """Full-state resume (reference trainer_base_ds_mp.py:297-299)."""
+        meta = self.load_meta(step)
+        if not meta.get("has_optimizer_state"):
+            raise ValueError(
+                f"checkpoint-{step} has no optimizer state (module-only / "
+                f"converter output); use load_params for a warm start")
+        params = self.load_params(step, params_template_stacked, manifest)
+        opt_canonical = _canonicalize_moments(opt_template, manifest, to_canonical=True)
+        restored_opt = self._ckptr.restore(
+            os.path.join(self.step_dir(step), "opt"), _abstract(opt_canonical))
+        opt_state = _canonicalize_moments(restored_opt, manifest, to_canonical=False)
+        return params, opt_state, int(meta["step"])
+
+
+def _config_meta(cfg: LlamaConfig) -> dict:
+    out = {}
+    for k, v in dataclasses.asdict(cfg).items():
+        if k in ("dtype", "param_dtype"):
+            out[k] = np.dtype(v).name if not isinstance(v, str) else v
+        else:
+            out[k] = v
+    return out
+
+
+def find_resume_checkpoint(root: str) -> tuple[int, str] | None:
+    """Resume detection (reference parses `checkpoint-N` dirnames,
+    trainer_base_ds_mp.py:452-455)."""
+    if not os.path.isdir(root):
+        return None
+    mgr = CheckpointManager(root)
+    step = mgr.latest_step()
+    if step is None:
+        return None
+    return step, mgr.step_dir(step)

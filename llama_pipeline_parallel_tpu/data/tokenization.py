@@ -1,0 +1,69 @@
+"""Tokenizer special-token normalization.
+
+Re-implements the reference's `general_util/tokenization_utils.py:15-56`
+(`expand_special_tokenizer`): normalize BOS/EOS/UNK/PAD across LLaMA-family
+tokenizers, with the same environment-variable overrides (EOS_TOKEN /
+BOS_TOKEN / UNK_TOKEN / PAD_TOKEN, reference :19-33) and the pad -> eos
+fallback.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from llama_pipeline_parallel_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# Reference defaults (general_util/tokenization_utils.py:7-10)
+DEFAULT_BOS_TOKEN = "<s>"
+DEFAULT_EOS_TOKEN = "</s>"
+DEFAULT_UNK_TOKEN = "<unk>"
+
+
+def tokenizer_get_name(tokenizer: Any) -> str:
+    """Lower-cased class name, the reference's model-family switch
+    (data/data_utils.py:19-23)."""
+    return tokenizer.__class__.__name__.lower()
+
+
+def is_seq2seq_tokenizer(tokenizer: Any) -> bool:
+    """True for encoder-decoder tokenizers (reference
+    general_util/tokenization_utils.py:59-61)."""
+    name = tokenizer_get_name(tokenizer)
+    return any(k in name for k in ("t5", "bart", "mbart", "pegasus", "marian", "blenderbot"))
+
+
+def expand_special_tokenizer(tokenizer: Any) -> int:
+    """Ensure bos/eos/unk/pad exist; returns how many NEW tokens were added
+    (callers must resize embeddings by that amount, reference
+    convert2ckpt.py:60-63)."""
+    special: dict[str, str] = {}
+
+    # Fill in ONLY missing tokens — a tokenizer shipping nonstandard specials
+    # (e.g. a llama-class tokenizer with its own bos/eos) must keep them, or
+    # the pretrained weights' special-token ids silently stop matching.
+    if tokenizer.bos_token is None:
+        special["bos_token"] = DEFAULT_BOS_TOKEN
+    if tokenizer.eos_token is None:
+        special["eos_token"] = DEFAULT_EOS_TOKEN
+    if tokenizer.unk_token is None:
+        special["unk_token"] = DEFAULT_UNK_TOKEN
+
+    # Environment overrides (reference :19-33)
+    for env, key in (("BOS_TOKEN", "bos_token"), ("EOS_TOKEN", "eos_token"),
+                     ("UNK_TOKEN", "unk_token"), ("PAD_TOKEN", "pad_token")):
+        if os.environ.get(env):
+            special[key] = os.environ[env]
+            logger.info("special-token override from $%s: %s=%r", env, key, special[key])
+
+    num_added = 0
+    if special:
+        num_added = tokenizer.add_special_tokens(special)
+
+    if tokenizer.pad_token is None:
+        # pad -> eos fallback (reference :44-50): no new embedding row needed
+        tokenizer.pad_token = tokenizer.eos_token
+        logger.info("pad_token unset; falling back to eos_token %r", tokenizer.eos_token)
+    return num_added

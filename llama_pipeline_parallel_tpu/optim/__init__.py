@@ -1,0 +1,5 @@
+from llama_pipeline_parallel_tpu.optim.optimizer import (  # noqa: F401
+    OptimizerConfig,
+    make_optimizer,
+    warmup_decay_schedule,
+)

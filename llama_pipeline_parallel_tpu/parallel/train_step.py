@@ -1,0 +1,163 @@
+"""The jitted train step: pipeline grads + ZeRO-1-sharded optimizer update.
+
+One call of the returned function does everything the reference's
+`engine.train_batch(data_iter)` does (reference trainer_base_ds_mp.py:354):
+runs `num_microbatches` microbatches through the pipeline (fwd+bwd), reduces
+gradients across DP, clips, steps AdamW + LR schedule, and returns the mean
+loss — except here it is one XLA program with no Python in the hot loop.
+
+ZeRO-1 (reference conf yaml `zero_optimization: stage 1` + reduce-scatter):
+optimizer moments are sharded over the `dp` axis via GSPMD sharding
+annotations — each dp replica owns a 1/dp slice of mu/nu, XLA inserts the
+reduce-scatter/all-gather traffic around the (sharded) update. Params remain
+dp-replicated fp32 masters.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+from llama_pipeline_parallel_tpu.parallel.mesh import AXIS_DP
+from llama_pipeline_parallel_tpu.parallel.pipeline import (
+    PipelineConfig,
+    make_pipeline_loss_and_grad,
+    stage_param_specs,
+)
+
+Params = dict
+
+
+class TrainState(NamedTuple):
+    step: jax.Array
+    params: Params  # stage-stacked, fp32 master, dp-replicated
+    opt_state: Any  # ZeRO-1: dp-sharded moments
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 sharding-spec construction
+# ---------------------------------------------------------------------------
+
+def _zero1_leaf_spec(param_spec: P, shape: tuple[int, ...], dp_size: int) -> P:
+    """Extend a param's spec with dp sharding on its last dim (if it divides).
+
+    Sharding the trailing (feature) dim keeps the stage axis layout intact and
+    divides evenly for every matmul weight; small vectors stay replicated.
+    """
+    if len(shape) < 2 or dp_size == 1 or shape[-1] % dp_size:
+        return param_spec
+    spec = list(param_spec) + [None] * (len(shape) - len(param_spec))
+    if spec[-1] is None:
+        spec[-1] = AXIS_DP
+    return P(*spec)
+
+
+def zero1_opt_state_specs(
+    tx: optax.GradientTransformation,
+    params: Params,
+    param_specs: Params,
+    dp_size: int,
+) -> Any:
+    """PartitionSpec tree for `tx.init(params)`.
+
+    Moment leaves mirror param leaves (same tree paths under mu/nu), so specs
+    are matched by path suffix; scalar state (step counts) is replicated.
+    """
+    flat_param_specs = {
+        jax.tree_util.keystr(path): (spec, leaf.shape)
+        for (path, spec), leaf in zip(
+            jax.tree_util.tree_flatten_with_path(param_specs)[0],
+            jax.tree.leaves(params),
+        )
+    }
+    opt_shapes = jax.eval_shape(tx.init, params)
+
+    def spec_for(path, leaf):
+        ks = jax.tree_util.keystr(path)
+        for pks, (pspec, pshape) in flat_param_specs.items():
+            if ks.endswith(pks) and tuple(leaf.shape) == tuple(pshape):
+                return _zero1_leaf_spec(pspec, leaf.shape, dp_size)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, opt_shapes)
+
+
+def state_shardings(mesh: Mesh, tx: optax.GradientTransformation, params_like: Params
+                    ) -> TrainState:
+    """NamedSharding tree for the full TrainState."""
+    param_specs = stage_param_specs(params_like)
+    opt_specs = zero1_opt_state_specs(tx, params_like, param_specs, mesh.shape[AXIS_DP])
+    to_sharding = lambda spec: NamedSharding(mesh, spec)
+    return TrainState(
+        step=to_sharding(P()),
+        params=jax.tree.map(to_sharding, param_specs),
+        opt_state=jax.tree.map(to_sharding, opt_specs,
+                               is_leaf=lambda x: isinstance(x, P)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# State init / step
+# ---------------------------------------------------------------------------
+
+def init_train_state(
+    params_stacked: Params,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+) -> TrainState:
+    """Place params and freshly initialized optimizer state onto the mesh with
+    ZeRO-1 shardings."""
+    shardings = state_shardings(mesh, tx, params_stacked)
+    # jit-identity (no donation) guarantees NEW buffers: a bare device_put can
+    # alias the caller's arrays when shardings are compatible, and the donated
+    # train step would then delete the caller's copies out from under it.
+    params = jax.jit(lambda p: p, out_shardings=shardings.params)(params_stacked)
+    opt_state = jax.jit(tx.init, out_shardings=shardings.opt_state)(params)
+    step = jax.device_put(jnp.zeros((), jnp.int32), shardings.step)
+    return TrainState(step=step, params=params, opt_state=opt_state)
+
+
+def make_train_step(
+    mesh: Mesh,
+    cfg: LlamaConfig,
+    pcfg: PipelineConfig,
+    tx: optax.GradientTransformation,
+    schedule: optax.Schedule,
+    params_like: Params,
+    attn_fn: Callable | None = None,
+) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
+    """Build the donated, fully-sharded jitted train step."""
+    from llama_pipeline_parallel_tpu.ops.attention import attention
+
+    loss_grad_fn = make_pipeline_loss_and_grad(
+        mesh, cfg, pcfg, params_like, attn_fn=attn_fn or attention)
+    shardings = state_shardings(mesh, tx, params_like)
+    batch_sharding = NamedSharding(mesh, P(AXIS_DP))
+
+    def step_fn(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+        loss, grads = loss_grad_fn(state.params, batch)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+            "lr": schedule(state.step),
+            "step": state.step + 1,
+        }
+        return TrainState(state.step + 1, new_params, new_opt_state), metrics
+
+    batch_shardings = {
+        "input_ids": batch_sharding, "attention_mask": batch_sharding,
+        "position_ids": batch_sharding, "labels": batch_sharding,
+    }
+    return jax.jit(
+        step_fn,
+        in_shardings=(shardings, batch_shardings),
+        out_shardings=(shardings, None),
+        donate_argnums=(0,),
+    )

@@ -1,0 +1,90 @@
+"""Train-step integration: optimization works under PP x DP with ZeRO-1."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from llama_pipeline_parallel_tpu.models.llama import model as llama
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+from llama_pipeline_parallel_tpu.optim import OptimizerConfig, make_optimizer, warmup_decay_schedule
+from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+from llama_pipeline_parallel_tpu.parallel import train_step as ts
+from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
+
+
+def test_warmup_decay_schedule():
+    sched = warmup_decay_schedule(1.0, total_steps=100, warmup_steps=10)
+    assert float(sched(0)) == 0.0
+    np.testing.assert_allclose(float(sched(10)), 1.0)
+    np.testing.assert_allclose(float(sched(55)), 0.5)
+    np.testing.assert_allclose(float(sched(100)), 0.0)
+    with pytest.raises(ValueError):
+        warmup_decay_schedule(1.0, total_steps=10, warmup_steps=10)
+
+
+def _setup(pp, dp, microbatches=2, lr=5e-3):
+    cfg = LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh(MeshConfig(pp=pp, dp=dp))
+    manifest = StageManifest.for_config(cfg, pp)
+    stacked = pl.stack_stages(params, manifest)
+    pcfg = pl.PipelineConfig(num_stages=pp, num_microbatches=microbatches)
+    ocfg = OptimizerConfig(learning_rate=lr, total_steps=50, warmup_steps=5)
+    tx, sched = make_optimizer(ocfg)
+    state = ts.init_train_state(stacked, tx, mesh)
+    step = ts.make_train_step(mesh, cfg, pcfg, tx, sched, stacked)
+    return cfg, mesh, state, step
+
+
+def _batch(cfg, batch_size, seqlen=16, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(3, cfg.vocab_size, size=(batch_size, seqlen)).astype(np.int32)
+    return {
+        "input_ids": jnp.asarray(ids),
+        "attention_mask": jnp.ones((batch_size, seqlen), jnp.int32),
+        "position_ids": jnp.broadcast_to(jnp.arange(seqlen, dtype=jnp.int32),
+                                         (batch_size, seqlen)),
+        "labels": jnp.asarray(ids),
+    }
+
+
+def test_loss_decreases_pp4_dp2(devices):
+    """The §7.2 end-to-end slice: loss goes down on a fixed batch, PP=4 DP=2."""
+    cfg, mesh, state, step = _setup(pp=4, dp=2, lr=1e-2)
+    batch = _batch(cfg, batch_size=2 * 2 * 2)
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] - 0.3, losses
+    assert int(state.step) == 8
+    assert np.isfinite(losses).all()
+
+
+def test_zero1_opt_state_is_dp_sharded(devices):
+    cfg, mesh, state, _ = _setup(pp=2, dp=2)
+    # find a moment leaf for a matmul weight and check its sharding spec
+    mu = state.opt_state[1][0].mu  # chain(clip, adamw) -> adamw scale_by_adam
+    spec = mu["layers"]["attn"]["wq"].sharding.spec
+    assert "dp" in jax.tree.leaves(tuple(spec)), spec
+    assert spec[0] == "pp"
+    # params stay dp-replicated
+    pspec = state.params["layers"]["attn"]["wq"].sharding.spec
+    assert "dp" not in [s for s in jax.tree.leaves(tuple(pspec))]
+
+
+def test_train_step_matches_across_topologies(devices):
+    """Same data, same init: PP=4xDP=2 and PP=1xDP=1 produce the same params
+    after a step (the hybrid-grid determinism the reference could never test)."""
+    cfg1, _, state1, step1 = _setup(pp=1, dp=1, microbatches=4, lr=1e-3)
+    cfg4, _, state4, step4 = _setup(pp=4, dp=2, microbatches=2, lr=1e-3)
+    batch = _batch(cfg1, batch_size=4)
+    state1, m1 = step1(state1, batch)
+    state4, m4 = step4(state4, batch)
+    np.testing.assert_allclose(float(m1["loss"]), float(m4["loss"]), rtol=1e-5)
+    p1 = state1.params["layers"]["attn"]["wq"].reshape(4, -1)
+    p4 = np.asarray(state4.params["layers"]["attn"]["wq"]).reshape(4, -1)
+    np.testing.assert_allclose(p1, p4, rtol=1e-4, atol=1e-7)

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Offline HF -> native checkpoint converter.
+
+TPU-native replacement for the reference's `convert2ckpt.py` (whole file):
+loads an HF LLaMA, optionally expands vocab for added special tokens
+(reference convert2ckpt.py:60-63), and writes a module-only checkpoint in the
+canonical Orbax layout plus tokenizer/config alongside (reference :79-80),
+with a `latest` tag (reference :76-77).
+
+Usage:
+    python tools/convert_hf.py --model_name_or_path <hf-dir> --output_dir <dir>
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def convert(model_name_or_path: str, output_dir: str, expand_vocab: bool = True) -> None:
+    import jax.numpy as jnp
+    from transformers import AutoTokenizer, LlamaForCausalLM
+
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
+    from llama_pipeline_parallel_tpu.data.tokenization import expand_special_tokenizer
+    from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+    from llama_pipeline_parallel_tpu.models.llama.hf import params_from_hf_state_dict
+    from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+    from llama_pipeline_parallel_tpu.parallel.pipeline import stack_stages
+
+    try:
+        tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+    except Exception as e:  # weights-only model dirs have no tokenizer files
+        print(f"warning: no loadable tokenizer at {model_name_or_path} ({e!r}); "
+              f"skipping vocab expansion and tokenizer export", file=sys.stderr)
+        tokenizer = None
+    model = LlamaForCausalLM.from_pretrained(model_name_or_path)
+    if expand_vocab and tokenizer is not None:
+        num_added = expand_special_tokenizer(tokenizer)
+        if num_added:
+            model.resize_token_embeddings(len(tokenizer))
+
+    cfg = LlamaConfig.from_hf_config(model.config, dtype=jnp.bfloat16)
+    params = params_from_hf_state_dict(model.state_dict(), cfg)
+    # Canonical layout is PP-agnostic; save through the trivial 1-stage manifest.
+    manifest = StageManifest(num_layers=cfg.num_hidden_layers, num_stages=1)
+    mgr = CheckpointManager(output_dir)
+    path = mgr.save(step=0, params_stacked=stack_stages(params, manifest),
+                    manifest=manifest, cfg=cfg, opt_state=None)
+    if tokenizer is not None:
+        tokenizer.save_pretrained(output_dir)
+    model.config.save_pretrained(output_dir)
+    print(f"wrote module-only checkpoint to {path}")
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model_name_or_path", required=True)
+    p.add_argument("--output_dir", required=True)
+    p.add_argument("--no_expand_vocab", action="store_true",
+                   help="skip special-token vocab expansion")
+    args = p.parse_args(argv)
+    convert(args.model_name_or_path, args.output_dir, expand_vocab=not args.no_expand_vocab)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
