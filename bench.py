@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Single-chip training-throughput benchmark.
+
+Runs the real train-step path (pipeline machinery at PP=1, remat, bf16
+compute, fp32 AdamW with ZeRO-1 layout) on a ~550M-param LLaMA-shaped model at
+the reference workload shape (seq 512; reference conf yaml:32) and prints ONE
+JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no throughput numbers (BASELINE.md), so vs_baseline
+is measured MFU / 0.45 — the 45%-MFU north-star from BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from __graft_entry__ import _bench_config
+    from llama_pipeline_parallel_tpu.models.llama import model as llama
+    from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+    from llama_pipeline_parallel_tpu.optim import OptimizerConfig, make_optimizer
+    from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+    from llama_pipeline_parallel_tpu.parallel import train_step as ts
+    from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
+    from llama_pipeline_parallel_tpu.utils.metrics import (
+        detect_chip_peak_flops,
+        train_flops_per_token,
+    )
+
+    cfg = _bench_config()
+    batch_size, seq = 8, 512
+
+    mesh = make_mesh(MeshConfig())  # single chip
+    manifest = StageManifest.for_config(cfg, 1)
+    stacked = pl.stack_stages(llama.init_params(jax.random.PRNGKey(0), cfg), manifest)
+    pcfg = pl.PipelineConfig(num_stages=1, num_microbatches=1, remat=True)
+    tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-4, total_steps=1000,
+                                               warmup_steps=10))
+    state = ts.init_train_state(stacked, tx, mesh)
+    step = ts.make_train_step(mesh, cfg, pcfg, tx, sched, stacked)
+
+    ids = np.random.RandomState(0).randint(3, cfg.vocab_size,
+                                           (batch_size, seq)).astype(np.int32)
+    batch = {
+        "input_ids": jnp.asarray(ids),
+        "attention_mask": jnp.ones((batch_size, seq), jnp.int32),
+        "position_ids": jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32),
+                                         (batch_size, seq)),
+        "labels": jnp.asarray(ids),
+    }
+
+    # warmup (compile) + steady-state timing. The loss VALUE is fetched every
+    # step: on the axon remote platform block_until_ready alone does not wait
+    # for the donated-state dependency chain, so value-fetch is the only
+    # reliable execution barrier (cost: one scalar D2H per step).
+    state, metrics = step(state, batch)
+    float(metrics["loss"])
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, batch)
+        float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch_size * seq
+    tps = tokens_per_step * n_steps / dt
+    peak = detect_chip_peak_flops() or 197e12
+    mfu = train_flops_per_token(cfg, seq) * tps / peak
+    print(json.dumps({
+        "metric": "tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "mfu": round(mfu, 4),
+        "step_time_ms": round(1000 * dt / n_steps, 1),
+        "model": "llama-550m seq512 bs8 bf16 remat",
+    }))
+
+
+if __name__ == "__main__":
+    main()

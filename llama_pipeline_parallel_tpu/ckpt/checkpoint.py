@@ -80,17 +80,23 @@ class CheckpointManager:
     def step_dir(self, step: int) -> str:
         return os.path.join(self.root, f"checkpoint-{step}")
 
+    def _is_complete(self, name: str) -> bool:
+        # meta.json is written LAST (after the async array writes finish), so
+        # its presence marks a durably complete checkpoint; an interrupted
+        # save leaves a dir that must be ignored, not resumed from.
+        return os.path.isfile(os.path.join(self.root, name, "meta.json"))
+
     def latest_step(self) -> int | None:
         tag = os.path.join(self.root, LATEST_TAG)
         if os.path.exists(tag):
             with open(tag) as f:
                 name = f.read().strip()
             m = _CKPT_RE.match(name)
-            if m and os.path.isdir(os.path.join(self.root, name)):
+            if m and self._is_complete(name):
                 return int(m.group(1))
             logger.warning("stale latest tag %r; falling back to directory scan", name)
         steps = [int(m.group(1)) for d in os.listdir(self.root)
-                 if (m := _CKPT_RE.match(d)) and os.path.isdir(os.path.join(self.root, d))]
+                 if (m := _CKPT_RE.match(d)) and self._is_complete(d)]
         return max(steps) if steps else None
 
     # -- save -------------------------------------------------------------

@@ -1,0 +1,100 @@
+"""Sequence-to-sequence -> causal-LM conversion and batching.
+
+Re-designs the reference's FLAN collator stack (reference data/flan.py:149-309)
+with its protocol bugs fixed (SURVEY.md §3.5):
+- no index column smuggled into the labels (reference :302 made labels one
+  longer than logits);
+- no materialized [bsz, 1, L, L] fp16 causal mask (reference :194-243) — the
+  batch carries a 1-D per-token attention mask and the causal predicate lives
+  inside the attention op;
+- numpy end to end (host-side), handed to jax as one batch dict.
+
+Batch protocol: {"input_ids", "attention_mask", "position_ids", "labels"},
+all [batch, seq]. The first pipeline stage consumes ids/mask/positions, the
+last stage consumes labels — matching the reference's
+`((input_ids, attention_mask, position_ids), labels)` tuple split
+(reference data/flan.py:304-307) without the tuple plumbing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+IGNORE_INDEX = -100  # reference data/flan.py:187
+
+
+def seq2seq_to_causal(
+    inputs: Sequence[str],
+    targets: Sequence[str],
+    tokenizer: Any,
+    max_seq_length: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Tokenize `input + " " + target + eos` pairs for decoder-only training.
+
+    The reference's `vanilla_seq2seq_convertor` (data/flan.py:149-170)
+    double-tokenizes: once for the combined text and once for the prompt alone
+    to find how many tokens to mask. Same approach here (it is the only
+    robust way across tokenizers), vectorized over the batch.
+
+    Returns (input_ids, attention_mask, prompt_lens), right-padded.
+    """
+    texts = [f"{inp} {tgt}{tokenizer.eos_token}" for inp, tgt in zip(inputs, targets)]
+    enc = tokenizer(list(texts), max_length=max_seq_length, truncation=True,
+                    padding="max_length", return_tensors="np")
+    prompt_enc = tokenizer(list(inputs), max_length=max_seq_length, truncation=True,
+                           return_length=True)
+    prompt_lens = np.asarray([len(x) for x in prompt_enc["input_ids"]], np.int32)
+    return (enc["input_ids"].astype(np.int32),
+            enc["attention_mask"].astype(np.int32),
+            prompt_lens)
+
+
+def get_lm_labels(input_ids: np.ndarray, attention_mask: np.ndarray,
+                  prompt_lens: np.ndarray) -> np.ndarray:
+    """Labels with prompt tokens and padding masked to IGNORE_INDEX
+    (reference get_lm_labels, data/flan.py:181-190)."""
+    labels = input_ids.astype(np.int32).copy()
+    positions = np.arange(input_ids.shape[1])[None, :]
+    labels[positions < prompt_lens[:, None]] = IGNORE_INDEX
+    labels[attention_mask == 0] = IGNORE_INDEX
+    return labels
+
+
+@dataclasses.dataclass
+class CausalLMCollator:
+    """(inputs, targets) string pairs -> pipeline batch dict.
+
+    Replaces `FlanCollatorOverCollator` (reference data/flan.py:263-309)."""
+
+    tokenizer: Any
+    max_seq_length: int
+
+    def __call__(self, examples: Sequence[Mapping[str, str]]) -> dict[str, np.ndarray]:
+        inputs = [ex["inputs"] for ex in examples]
+        targets = [ex["targets"] for ex in examples]
+        input_ids, attention_mask, prompt_lens = seq2seq_to_causal(
+            inputs, targets, self.tokenizer, self.max_seq_length)
+        labels = get_lm_labels(input_ids, attention_mask, prompt_lens)
+        seqlen = input_ids.shape[1]
+        position_ids = np.broadcast_to(
+            np.arange(seqlen, dtype=np.int32), input_ids.shape).copy()
+        return {
+            "input_ids": input_ids,
+            "attention_mask": attention_mask,
+            "position_ids": position_ids,
+            "labels": labels,
+        }
+
+
+@dataclasses.dataclass
+class PretokenizedCollator:
+    """Pass-through collator for datasets that already emit token arrays
+    (the synthetic/placeholder path, reference trainer_base_ds_mp.py:329-336)."""
+
+    def __call__(self, examples: Sequence[Mapping[str, np.ndarray]]) -> dict[str, np.ndarray]:
+        keys = ("input_ids", "attention_mask", "position_ids", "labels")
+        return {k: np.stack([np.asarray(ex[k]) for ex in examples]).astype(np.int32)
+                for k in keys}

@@ -1,0 +1,127 @@
+"""Datasets.
+
+Re-implements the reference's dataset layer without torch:
+- `JsonSeq2SeqDataset` covers PromptDataset / FLANDataset (reference
+  data/flan.py:36-62): records with "inputs"/"targets" fields from json/jsonl,
+  with the same filter hook (`load_flan_data_w_filter`, reference :15-29).
+- `SyntheticDataset` is the `TestDataset` placeholder (reference
+  data/test.py:4-22) generalized: deterministic random token batches with a
+  `pseudo_dataset_len`, used for smoke tests, benches, and any host whose
+  pipeline stages never consume real data (reference README.md:64-129).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Iterator, Mapping, Sequence
+
+import numpy as np
+
+from llama_pipeline_parallel_tpu.data.collator import IGNORE_INDEX
+from llama_pipeline_parallel_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def load_seq2seq_records(
+    path: str,
+    input_field: str = "inputs",
+    target_field: str = "targets",
+    filter_fn: Callable[[Mapping[str, Any]], bool] | None = None,
+) -> list[dict[str, str]]:
+    """Load {"inputs": ..., "targets": ...} records from .json or .jsonl,
+    with an optional filter (reference load_flan_data_w_filter,
+    data/flan.py:15-29 drops empty-target rows)."""
+    records: list[dict[str, str]] = []
+    with open(path) as f:
+        if path.endswith(".jsonl"):
+            rows = (json.loads(line) for line in f if line.strip())
+        else:
+            rows = json.load(f)
+        for row in rows:
+            if filter_fn is not None and not filter_fn(row):
+                continue
+            records.append({"inputs": str(row[input_field]),
+                            "targets": str(row[target_field])})
+    logger.info("loaded %d records from %s", len(records), path)
+    return records
+
+
+def drop_empty_targets(row: Mapping[str, Any]) -> bool:
+    return bool(str(row.get("targets", "")).strip())
+
+
+@dataclasses.dataclass
+class JsonSeq2SeqDataset:
+    """Sequence protocol over seq2seq records (PromptDataset/FLANDataset
+    equivalent)."""
+
+    path: str
+    input_field: str = "inputs"
+    target_field: str = "targets"
+    filter_empty: bool = True
+
+    def __post_init__(self) -> None:
+        self._records = load_seq2seq_records(
+            self.path, self.input_field, self.target_field,
+            drop_empty_targets if self.filter_empty else None)
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+    def __getitem__(self, idx: int) -> dict[str, str]:
+        return self._records[idx]
+
+
+@dataclasses.dataclass
+class ConcatDataset:
+    """Concatenation of datasets (the reference concatenates multi-file
+    datasets recursively, trainer_base_ds_mp.py:132-139)."""
+
+    datasets: Sequence[Any]
+
+    def __len__(self) -> int:
+        return sum(len(d) for d in self.datasets)
+
+    def __getitem__(self, idx: int):
+        if idx < 0:
+            idx += len(self)
+        for d in self.datasets:
+            if idx < len(d):
+                return d[idx]
+            idx -= len(d)
+        raise IndexError(idx)
+
+
+@dataclasses.dataclass
+class SyntheticDataset:
+    """Deterministic random-token dataset (TestDataset equivalent,
+    reference data/test.py:4-22) that already emits the full batch protocol."""
+
+    vocab_size: int
+    seq_length: int
+    pseudo_dataset_len: int = 1024
+    seed: int = 0
+    pad_fraction: float = 0.0  # trailing fraction of each row marked padding
+
+    def __len__(self) -> int:
+        return self.pseudo_dataset_len
+
+    def __getitem__(self, idx: int) -> dict[str, np.ndarray]:
+        if not 0 <= idx < self.pseudo_dataset_len:
+            raise IndexError(idx)
+        rng = np.random.RandomState((self.seed * 1_000_003 + idx) % (2**31))
+        ids = rng.randint(3, self.vocab_size, size=(self.seq_length,)).astype(np.int32)
+        mask = np.ones((self.seq_length,), np.int32)
+        n_pad = int(self.seq_length * self.pad_fraction)
+        if n_pad:
+            mask[-n_pad:] = 0
+        labels = np.where(mask == 1, ids, IGNORE_INDEX).astype(np.int32)
+        return {
+            "input_ids": ids,
+            "attention_mask": mask,
+            "position_ids": np.arange(self.seq_length, dtype=np.int32),
+            "labels": labels,
+        }

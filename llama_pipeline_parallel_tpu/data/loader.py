@@ -1,0 +1,132 @@
+"""DP-sharded sampling and the training data loader.
+
+Replaces the reference's input-side plumbing (reference
+trainer_base_ds_mp.py:309-342): `DistributedSampler(num_replicas=dp_degree,
+rank=dp_id)` with `set_epoch` reshuffling, the infinite `RepeatingLoader`,
+and the per-stage data-feeding rules.
+
+TPU-native difference: under jit the batch is a GLOBAL array sharded over the
+`dp` mesh axis, so there is no per-rank Python process pulling its own
+iterator. On a single host the loader materializes the full global batch
+(ordered so dp shard d gets the d-th contiguous slice — matching the
+PartitionSpec('dp') layout). On multi-host, each process loads only the
+shards of the dp replicas it hosts and `form_global_batch` assembles the
+jax.Array from per-host data (the analogue of only boundary-stage ranks
+fetching real data, reference README.md:64-129).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ShardedSampler:
+    """Deterministic per-epoch shuffling + dp sharding + drop_last.
+
+    Equivalent of torch's DistributedSampler as used at reference
+    trainer_base_ds_mp.py:312-316, with `set_epoch` (reference :341-342).
+    """
+
+    dataset_len: int
+    num_replicas: int
+    rank: int
+    shuffle: bool = True
+    seed: int = 0
+    drop_last: bool = True
+
+    def __post_init__(self) -> None:
+        if not 0 <= self.rank < self.num_replicas:
+            raise ValueError(f"rank {self.rank} out of range for {self.num_replicas} replicas")
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    @property
+    def num_samples_per_replica(self) -> int:
+        if self.drop_last:
+            return self.dataset_len // self.num_replicas
+        return -(-self.dataset_len // self.num_replicas)
+
+    def indices(self) -> np.ndarray:
+        order = np.arange(self.dataset_len)
+        if self.shuffle:
+            order = np.random.RandomState(self.seed * 131071 + self._epoch).permutation(order)
+        n = self.num_samples_per_replica
+        if not self.drop_last:
+            pad = n * self.num_replicas - len(order)
+            if pad:
+                order = np.concatenate([order, order[:pad]])
+        return order[self.rank::self.num_replicas][:n]
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.indices().tolist())
+
+    def __len__(self) -> int:
+        return self.num_samples_per_replica
+
+
+@dataclasses.dataclass
+class DataLoader:
+    """Batched, collated iteration over a dataset with dp-aware ordering.
+
+    Yields GLOBAL batch dicts of shape [dp * per_replica_batch, ...] where
+    rows [d*b:(d+1)*b] belong to dp replica d — the exact layout
+    PartitionSpec('dp') splits along the batch dim.
+    """
+
+    dataset: Any
+    collate_fn: Callable[[Sequence[Any]], dict[str, np.ndarray]]
+    per_replica_batch: int
+    dp_size: int = 1
+    shuffle: bool = True
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        self._samplers = [
+            ShardedSampler(len(self.dataset), self.dp_size, rank=d,
+                           shuffle=self.shuffle, seed=self.seed)
+            for d in range(self.dp_size)
+        ]
+
+    def set_epoch(self, epoch: int) -> None:
+        for s in self._samplers:
+            s.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        """Batches per epoch."""
+        return self._samplers[0].num_samples_per_replica // self.per_replica_batch
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        per_replica = [s.indices() for s in self._samplers]
+        for b in range(len(self)):
+            rows = []
+            for d in range(self.dp_size):
+                sl = per_replica[d][b * self.per_replica_batch:(b + 1) * self.per_replica_batch]
+                rows.extend(self.dataset[int(i)] for i in sl)
+            yield self.collate_fn(rows)
+
+
+class RepeatingLoader:
+    """Infinite wrapper advancing epochs (reference
+    `deepspeed.utils.RepeatingLoader`, trainer_base_ds_mp.py:339, plus the
+    sampler.set_epoch call the reference does manually at :341-342)."""
+
+    def __init__(self, loader: DataLoader):
+        self.loader = loader
+        self.epoch = 0
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        while True:
+            self.loader.set_epoch(self.epoch)
+            got_any = False
+            for batch in self.loader:
+                got_any = True
+                yield batch
+            if not got_any:
+                raise ValueError("underlying loader is empty; cannot repeat")
+            self.epoch += 1

@@ -1,0 +1,123 @@
+"""Config system: YAML + `_target_` factories + `${}` interpolation + CLI overrides.
+
+A dependency-free re-implementation of the Hydra surface the reference uses
+(reference trainer_base_ds_mp.py:388 `@hydra.main`, conf yaml `_target_`
+nodes at :12-19,28-53, `${}` interpolation at :48,66,120-136, and the argv
+munging shim at :464-471):
+
+- `load_config(path, overrides)` -> plain dict, with `${key.path}` strings
+  resolved against the root and `key.path=value` overrides applied first.
+- `instantiate(node, **extra)` -> import the dotted `_target_` and call it
+  with the node's other keys (children instantiated recursively), matching
+  `hydra.utils.instantiate/call` semantics for the cases the reference uses.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import re
+from typing import Any
+
+import yaml
+
+_INTERP_RE = re.compile(r"\$\{([a-zA-Z0-9_.]+)\}")
+# YAML 1.1 leaves exponent-form numbers without a dot ("1e-2") as strings.
+_SCI_FLOAT_RE = re.compile(r"[+-]?(\d+\.?\d*|\.\d+)[eE][+-]?\d+")
+
+
+def _get_path(root: Any, dotted: str) -> Any:
+    node = root
+    for part in dotted.split("."):
+        if isinstance(node, list):
+            node = node[int(part)]
+        else:
+            node = node[part]
+    return node
+
+
+def _set_path(root: dict, dotted: str, value: Any) -> None:
+    parts = dotted.split(".")
+    node = root
+    for part in parts[:-1]:
+        node = node.setdefault(part, {})
+    node[parts[-1]] = value
+
+
+def _parse_scalar(text: str) -> Any:
+    try:
+        return ast.literal_eval(text)
+    except (ValueError, SyntaxError):
+        return text
+
+
+def _resolve(node: Any, root: Any, seen: tuple[str, ...] = ()) -> Any:
+    if isinstance(node, dict):
+        return {k: _resolve(v, root, seen) for k, v in node.items()}
+    if isinstance(node, list):
+        return [_resolve(v, root, seen) for v in node]
+    if isinstance(node, str):
+        if _SCI_FLOAT_RE.fullmatch(node):
+            return float(node)
+        full = _INTERP_RE.fullmatch(node)
+        if full:  # whole-string interpolation keeps the referee's type
+            key = full.group(1)
+            if key in seen:
+                raise ValueError(f"interpolation cycle via ${{{key}}}")
+            return _resolve(_get_path(root, key), root, seen + (key,))
+        def sub(m: re.Match) -> str:
+            key = m.group(1)
+            if key in seen:
+                raise ValueError(f"interpolation cycle via ${{{key}}}")
+            return str(_resolve(_get_path(root, key), root, seen + (key,)))
+
+        return _INTERP_RE.sub(sub, node)
+    return node
+
+
+def load_config(path: str, overrides: list[str] | None = None) -> dict:
+    """Load YAML, apply `a.b=c` overrides, resolve `${}` interpolations."""
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    if not isinstance(cfg, dict):
+        raise ValueError(f"top-level config must be a mapping, got {type(cfg)}")
+    for ov in overrides or []:
+        ov = ov.lstrip("-")  # accept --key=val torchrun-style (reference :464-471)
+        if "=" not in ov:
+            raise ValueError(f"override {ov!r} is not of the form key=value")
+        key, _, val = ov.partition("=")
+        _set_path(cfg, key.strip(), _parse_scalar(val.strip()))
+    return _resolve(cfg, cfg)
+
+
+def resolve_target(dotted: str) -> Any:
+    """Import `pkg.mod.Attr[.attr2...]` — walking back over trailing attrs so
+    classmethod/staticmethod targets like `...LlamaConfig.tiny` resolve."""
+    if "." not in dotted:
+        raise ValueError(f"_target_ {dotted!r} must be a dotted path")
+    parts = dotted.split(".")
+    last_err: Exception | None = None
+    for split in range(len(parts) - 1, 0, -1):
+        mod_name = ".".join(parts[:split])
+        try:
+            obj = importlib.import_module(mod_name)
+        except ModuleNotFoundError as e:
+            last_err = e
+            continue
+        for attr in parts[split:]:
+            obj = getattr(obj, attr)
+        return obj
+    raise ModuleNotFoundError(f"cannot resolve _target_ {dotted!r}") from last_err
+
+
+def instantiate(node: Any, **extra: Any) -> Any:
+    """Hydra-style: dicts with `_target_` become calls; children first."""
+    if isinstance(node, dict) and "_target_" in node:
+        kwargs = {k: instantiate(v) for k, v in node.items() if k != "_target_"}
+        kwargs.update(extra)
+        return resolve_target(node["_target_"])(**kwargs)
+    if isinstance(node, dict):
+        return {k: instantiate(v) for k, v in node.items()}
+    if isinstance(node, list):
+        return [instantiate(v) for v in node]
+    return node

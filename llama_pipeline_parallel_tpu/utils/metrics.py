@@ -1,0 +1,130 @@
+"""Observability: throughput/MFU accounting and the metrics writer.
+
+Fills the reference's §5.1/§5.5 surface: rank-0 scalar logging of lr and
+windowed mean loss every `logging_steps` (reference
+trainer_base_ds_mp.py:360-374 to wandb) plus the per-step throughput DeepSpeed
+printed via `steps_per_print` — extended with tokens/sec/chip and MFU, the
+BASELINE.md north-star metrics the reference never measured.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any
+
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+from llama_pipeline_parallel_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# bf16 peak TFLOP/s per chip by TPU generation (public figures)
+TPU_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def param_count(cfg: LlamaConfig) -> int:
+    d, f, L, V = (cfg.hidden_size, cfg.intermediate_size,
+                  cfg.num_hidden_layers, cfg.vocab_size)
+    kv_dim = cfg.kv_heads * cfg.head_dim
+    per_layer = d * d * 2 + d * kv_dim * 2 + 3 * d * f + 2 * d
+    return V * d * 2 + L * per_layer + d
+
+
+def train_flops_per_token(cfg: LlamaConfig, seq_length: int) -> float:
+    """PaLM-style accounting: 6*N + 12*L*d*S per trained token (fwd+bwd,
+    attention quadratic term included)."""
+    return 6.0 * param_count(cfg) + 12.0 * cfg.num_hidden_layers * cfg.hidden_size * seq_length
+
+
+def detect_chip_peak_flops() -> float | None:
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for key, flops in TPU_PEAK_FLOPS.items():
+        if key in kind:
+            return flops
+    return None
+
+
+@dataclasses.dataclass
+class Throughput:
+    """Rolling tokens/sec + MFU meter."""
+
+    cfg: LlamaConfig
+    seq_length: int
+    n_chips: int
+    peak_flops_per_chip: float | None = None
+
+    def __post_init__(self) -> None:
+        self._t0 = time.perf_counter()
+        self._tokens = 0
+        if self.peak_flops_per_chip is None:
+            self.peak_flops_per_chip = detect_chip_peak_flops()
+
+    def update(self, tokens: int) -> None:
+        self._tokens += tokens
+
+    def read_and_reset(self) -> dict[str, float]:
+        dt = max(time.perf_counter() - self._t0, 1e-9)
+        tps = self._tokens / dt
+        out = {"tokens_per_sec": tps, "tokens_per_sec_per_chip": tps / self.n_chips}
+        if self.peak_flops_per_chip:
+            flops = train_flops_per_token(self.cfg, self.seq_length) * tps
+            out["mfu"] = flops / (self.peak_flops_per_chip * self.n_chips)
+        self._t0 = time.perf_counter()
+        self._tokens = 0
+        return out
+
+
+class MetricsWriter:
+    """Scalars -> stdout log + metrics.jsonl + wandb/tensorboard when present.
+
+    The thin interface SURVEY.md §5.5 calls for; replaces the reference's
+    hardcoded wandb calls (trainer_base_ds_mp.py:441-447,373-374) and its
+    absent `WandbWriter` helper."""
+
+    def __init__(self, output_dir: str, config_snapshot: dict | None = None,
+                 use_wandb: bool = False, project: str = "llama-pipeline-tpu"):
+        os.makedirs(output_dir, exist_ok=True)
+        self._f = open(os.path.join(output_dir, "metrics.jsonl"), "a", buffering=1)
+        self._wandb = None
+        if config_snapshot is not None:
+            # run provenance: resolved config snapshot next to the checkpoints
+            # (reference trainer_base_ds_mp.py:439 saves training_config.yaml)
+            with open(os.path.join(output_dir, "training_config.json"), "w") as f:
+                json.dump(config_snapshot, f, indent=2, default=str)
+        if use_wandb:
+            try:
+                import wandb
+
+                self._wandb = wandb.init(project=project, config=config_snapshot)
+            except Exception as e:  # wandb not installed / offline
+                logger.warning("wandb unavailable (%r); falling back to jsonl only", e)
+
+    def log(self, step: int, scalars: dict[str, Any]) -> None:
+        record = {"step": step, **{k: _to_py(v) for k, v in scalars.items()}}
+        self._f.write(json.dumps(record) + "\n")
+        pretty = " ".join(f"{k}={record[k]:.5g}" if isinstance(record[k], float)
+                          else f"{k}={record[k]}" for k in record)
+        logger.info(pretty)
+        if self._wandb is not None:
+            self._wandb.log(scalars, step=step)
+
+    def close(self) -> None:
+        self._f.close()
+        if self._wandb is not None:
+            self._wandb.finish()
+
+
+def _to_py(v: Any) -> Any:
+    if hasattr(v, "item"):
+        return v.item()
+    return v

@@ -1,0 +1,50 @@
+"""Config system: YAML load, interpolation, overrides, factories."""
+
+import pytest
+
+from llama_pipeline_parallel_tpu.utils.config import instantiate, load_config
+
+
+def _write(tmp_path, text):
+    p = tmp_path / "c.yaml"
+    p.write_text(text)
+    return str(p)
+
+
+def test_interpolation_and_types(tmp_path):
+    cfg = load_config(_write(tmp_path, """
+model_name: /models/llama
+lr: 1e-4
+paths:
+  out: ${model_name}/out
+  lr_copy: ${lr}
+nested: ${paths.out}
+"""))
+    assert cfg["paths"]["out"] == "/models/llama/out"
+    assert cfg["nested"] == "/models/llama/out"
+    assert cfg["lr"] == 1e-4  # sci-notation coerced to float
+    assert cfg["paths"]["lr_copy"] == 1e-4  # whole-string interp keeps type
+
+
+def test_overrides(tmp_path):
+    path = _write(tmp_path, "a:\n  b: 1\nc: x\n")
+    cfg = load_config(path, ["a.b=2", "--c=hello", "d.e=[1,2]"])
+    assert cfg["a"]["b"] == 2
+    assert cfg["c"] == "hello"
+    assert cfg["d"]["e"] == [1, 2]
+    with pytest.raises(ValueError, match="key=value"):
+        load_config(path, ["oops"])
+
+
+def test_interpolation_cycle(tmp_path):
+    with pytest.raises(ValueError, match="cycle"):
+        load_config(_write(tmp_path, "a: ${b}\nb: ${a}\n"))
+
+
+def test_instantiate_target(tmp_path):
+    node = {"_target_": "llama_pipeline_parallel_tpu.models.llama.config.LlamaConfig.tiny",
+            "vocab_size": 128}
+    cfg = instantiate(node)
+    assert cfg.vocab_size == 128 and cfg.num_hidden_layers == 4
+    with pytest.raises(ValueError, match="dotted"):
+        instantiate({"_target_": "nodots"})

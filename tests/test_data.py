@@ -1,0 +1,141 @@
+"""Data layer: collator label masking, datasets, dp-sharded sampling, repeat."""
+
+import json
+
+import numpy as np
+import pytest
+
+from llama_pipeline_parallel_tpu.data.collator import (
+    IGNORE_INDEX,
+    CausalLMCollator,
+    PretokenizedCollator,
+    get_lm_labels,
+)
+from llama_pipeline_parallel_tpu.data.datasets import (
+    ConcatDataset,
+    JsonSeq2SeqDataset,
+    SyntheticDataset,
+)
+from llama_pipeline_parallel_tpu.data.loader import DataLoader, RepeatingLoader, ShardedSampler
+from llama_pipeline_parallel_tpu.data.tokenization import expand_special_tokenizer
+
+
+class FakeTokenizer:
+    """Whitespace tokenizer with an HF-ish callable interface."""
+
+    eos_token = "</s>"
+    pad_token = "</s>"
+
+    def _encode(self, text):
+        return [hash(w) % 1000 + 10 for w in text.split()]
+
+    def __call__(self, texts, max_length, truncation, padding=None, return_tensors=None,
+                 return_length=False):
+        ids = [self._encode(t)[:max_length] for t in texts]
+        if padding == "max_length":
+            mask = [[1] * len(x) + [0] * (max_length - len(x)) for x in ids]
+            ids = [x + [0] * (max_length - len(x)) for x in ids]
+            out = {"input_ids": np.asarray(ids), "attention_mask": np.asarray(mask)}
+            return out
+        return {"input_ids": ids}
+
+
+def test_get_lm_labels_masks_prompt_and_padding():
+    ids = np.arange(1, 9).reshape(1, 8)
+    mask = np.array([[1, 1, 1, 1, 1, 1, 0, 0]])
+    labels = get_lm_labels(ids, mask, prompt_lens=np.array([3]))
+    np.testing.assert_array_equal(
+        labels[0], [IGNORE_INDEX] * 3 + [4, 5, 6] + [IGNORE_INDEX] * 2)
+
+
+def test_causal_lm_collator_protocol():
+    coll = CausalLMCollator(FakeTokenizer(), max_seq_length=16)
+    batch = coll([{"inputs": "the quick brown", "targets": "fox jumps"},
+                  {"inputs": "hello", "targets": "world"}])
+    assert set(batch) == {"input_ids", "attention_mask", "position_ids", "labels"}
+    for v in batch.values():
+        assert v.shape == (2, 16)  # labels same length as inputs — no index column
+    # prompt region masked
+    assert (batch["labels"][0, :3] == IGNORE_INDEX).all()
+    assert (batch["labels"][0, 3:5] != IGNORE_INDEX).all()
+    # padding masked
+    assert (batch["labels"][batch["attention_mask"] == 0] == IGNORE_INDEX).all()
+
+
+def test_json_dataset_and_concat(tmp_path):
+    p1 = tmp_path / "a.jsonl"
+    with open(p1, "w") as f:
+        f.write(json.dumps({"inputs": "i1", "targets": "t1"}) + "\n")
+        f.write(json.dumps({"inputs": "i2", "targets": ""}) + "\n")  # filtered
+    p2 = tmp_path / "b.json"
+    with open(p2, "w") as f:
+        json.dump([{"inputs": "i3", "targets": "t3"}], f)
+    d1, d2 = JsonSeq2SeqDataset(str(p1)), JsonSeq2SeqDataset(str(p2))
+    assert len(d1) == 1 and len(d2) == 1
+    cat = ConcatDataset([d1, d2])
+    assert len(cat) == 2 and cat[1]["inputs"] == "i3"
+    with pytest.raises(IndexError):
+        cat[2]
+
+
+def test_sharded_sampler_partition_and_epochs():
+    samplers = [ShardedSampler(103, 4, rank=r, seed=1) for r in range(4)]
+    all_idx = np.concatenate([s.indices() for s in samplers])
+    assert len(all_idx) == 4 * (103 // 4)
+    assert len(np.unique(all_idx)) == len(all_idx)  # disjoint shards
+    e0 = samplers[0].indices().copy()
+    for s in samplers:
+        s.set_epoch(1)
+    assert not np.array_equal(e0, samplers[0].indices())  # reshuffles
+    samplers[0].set_epoch(0)
+    np.testing.assert_array_equal(e0, samplers[0].indices())  # deterministic
+
+
+def test_dataloader_global_layout_and_repeat():
+    ds = SyntheticDataset(vocab_size=50, seq_length=8, pseudo_dataset_len=12, seed=3)
+    dl = DataLoader(ds, PretokenizedCollator(), per_replica_batch=2, dp_size=2,
+                    shuffle=False)
+    assert len(dl) == 3  # 12 / 2 replicas / 2 per batch
+    batches = list(dl)
+    assert batches[0]["input_ids"].shape == (4, 8)
+    # dp replica 0 rows come first, replica 1 rows second
+    s0 = [ds[i]["input_ids"] for i in ShardedSampler(12, 2, 0, shuffle=False).indices()[:2]]
+    np.testing.assert_array_equal(batches[0]["input_ids"][:2], np.stack(s0))
+
+    rl = iter(RepeatingLoader(dl))
+    seen = [next(rl) for _ in range(7)]  # crosses two epoch boundaries
+    assert seen[3]["input_ids"].shape == (4, 8)
+
+
+def test_synthetic_dataset_deterministic():
+    ds = SyntheticDataset(vocab_size=100, seq_length=16, pseudo_dataset_len=4,
+                          pad_fraction=0.25)
+    a, b = ds[2], ds[2]
+    np.testing.assert_array_equal(a["input_ids"], b["input_ids"])
+    assert (a["attention_mask"][-4:] == 0).all()
+    assert (a["labels"][-4:] == IGNORE_INDEX).all()
+    with pytest.raises(IndexError):
+        ds[4]
+
+
+def test_expand_special_tokenizer_fills_missing_only():
+    class Tok:
+        bos_token = "<CUSTOM_BOS>"
+        eos_token = None
+        unk_token = "<unk>"
+        pad_token = None
+
+        def __init__(self):
+            self.added = {}
+
+        def add_special_tokens(self, d):
+            self.added.update(d)
+            for k, v in d.items():
+                setattr(self, k, v)
+            return len(d)
+
+    t = Tok()
+    n = expand_special_tokenizer(t)
+    assert n == 1 and t.eos_token == "</s>"
+    assert t.bos_token == "<CUSTOM_BOS>"  # untouched
+    assert t.pad_token == "</s>"  # pad -> eos fallback

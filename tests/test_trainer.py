@@ -1,0 +1,68 @@
+"""Trainer e2e: smoke run, checkpoint/resume continuity, warm start."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from llama_pipeline_parallel_tpu.train import run_training
+from llama_pipeline_parallel_tpu.utils.config import load_config
+
+
+def base_cfg(tmp_path, **kw):
+    cfg = {
+        "output_dir": str(tmp_path / "out"),
+        "mesh": {"pp": 2, "dp": 2},
+        "model": {"preset": "tiny", "dtype": "float32"},
+        "dataset": {"synthetic": True, "seq_length": 16, "pseudo_dataset_len": 128},
+        "seed": 7,
+        "per_device_train_batch_size": 2,
+        "gradient_accumulation_steps": 2,
+        "max_steps": 4,
+        "learning_rate": 1e-3,
+        "warmup_steps": 1,
+        "logging_steps": 2,
+        "save_steps": 0,
+        "save_final": True,
+    }
+    cfg.update(kw)
+    return cfg
+
+
+def test_smoke_run_writes_metrics_and_ckpt(tmp_path, devices):
+    summary = run_training(base_cfg(tmp_path))
+    assert summary["final_step"] == 4
+    out = summary["output_dir"]
+    lines = [json.loads(l) for l in open(os.path.join(out, "metrics.jsonl"))]
+    assert lines and {"loss", "lr", "tokens_per_sec"} <= set(lines[0])
+    assert os.path.isdir(os.path.join(out, "checkpoint-4"))
+    assert os.path.exists(os.path.join(out, "training_config.json"))
+
+
+def test_resume_continues_identically(tmp_path, devices):
+    """Interrupted-at-4 + resume-to-8 must equal straight-through-to-8
+    (the reference's resume fast-forward contract, trainer_base_ds_mp:345-351)."""
+    cfg_a = base_cfg(tmp_path, output_dir=str(tmp_path / "a"), max_steps=8)
+    straight = run_training(cfg_a)
+
+    cfg_b = base_cfg(tmp_path, output_dir=str(tmp_path / "b"), max_steps=4,
+                     total_steps=8)  # schedule horizon stays 8 across the interruption
+    run_training(cfg_b)
+    cfg_b2 = base_cfg(tmp_path, output_dir=str(tmp_path / "b"), max_steps=8)
+    resumed = run_training(cfg_b2)
+
+    np.testing.assert_allclose(resumed["final_loss"], straight["final_loss"], rtol=1e-6)
+
+
+def test_warm_start_requires_checkpoint(tmp_path, devices):
+    cfg = base_cfg(tmp_path, model_name_or_path=str(tmp_path / "missing"), resume=False)
+    with pytest.raises(FileNotFoundError, match="convert_hf"):
+        run_training(cfg)
+
+
+def test_shipped_configs_parse():
+    for name in ("tiny_smoke", "llama_7b_pp4", "llama_65b_pp8_dp4"):
+        cfg = load_config(f"conf/{name}.yaml")
+        assert isinstance(cfg["learning_rate"], float)
+        assert cfg["mesh"]["pp"] >= 1
