@@ -72,6 +72,13 @@ class CausalLMCollator:
     tokenizer: Any
     max_seq_length: int
 
+    def __post_init__(self) -> None:
+        # The whole attention stack (flash kernel, ring attention, the
+        # causal-only padding argument) assumes RIGHT padding; some published
+        # tokenizer configs ship padding_side="left" for generation.
+        if getattr(self.tokenizer, "padding_side", "right") != "right":
+            self.tokenizer.padding_side = "right"
+
     def __call__(self, examples: Sequence[Mapping[str, str]]) -> dict[str, np.ndarray]:
         inputs = [ex["inputs"] for ex in examples]
         targets = [ex["targets"] for ex in examples]

@@ -96,29 +96,52 @@ def decoder_layer(
     sin: jnp.ndarray,
     cfg: LlamaConfig,
     attn_fn: AttnFn = attention,
+    tp_axis: str | None = None,
 ) -> jnp.ndarray:
     """One transformer block (reference ParallelTransformerLayerPipe,
-    models/llama_ds_mp_wrap.py:135-181, which wraps HF LlamaDecoderLayer)."""
+    models/llama_ds_mp_wrap.py:135-181, which wraps HF LlamaDecoderLayer).
+
+    `tp_axis`: when set (inside shard_map with column/row-sharded weights),
+    qkv/gate/up are column-parallel and wo/down row-parallel, with the
+    Megatron f/g operator pair from parallel/tp.py. Head counts are derived
+    from the LOCAL weight shards, so the same code runs tp=1 and tp=N.
+    """
     b, s, d = x.shape
-    h, kv, hd = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    hd = cfg.head_dim
     dt = cfg.dtype
+
+    if tp_axis is not None:
+        from llama_pipeline_parallel_tpu.parallel.tp import tp_copy, tp_reduce
+    wq = layer["attn"]["wq"].astype(dt)
+    wk = layer["attn"]["wk"].astype(dt)
+    wv = layer["attn"]["wv"].astype(dt)
+    h_local = wq.shape[-1] // hd
+    kv_local = wk.shape[-1] // hd
 
     residual = x
     hidden = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
-    q = (hidden @ layer["attn"]["wq"].astype(dt)).reshape(b, s, h, hd)
-    k = (hidden @ layer["attn"]["wk"].astype(dt)).reshape(b, s, kv, hd)
-    v = (hidden @ layer["attn"]["wv"].astype(dt)).reshape(b, s, kv, hd)
+    if tp_axis is not None:
+        hidden = tp_copy(hidden, tp_axis)
+    q = (hidden @ wq).reshape(b, s, h_local, hd)
+    k = (hidden @ wk).reshape(b, s, kv_local, hd)
+    v = (hidden @ wv).reshape(b, s, kv_local, hd)
     q, k = apply_rope(q, k, cos, sin)
     attn_out = attn_fn(q, k, v, padding_mask, causal=True)
-    attn_out = attn_out.reshape(b, s, d) @ layer["attn"]["wo"].astype(dt)
+    attn_out = attn_out.reshape(b, s, -1) @ layer["attn"]["wo"].astype(dt)
+    if tp_axis is not None:
+        attn_out = tp_reduce(attn_out, tp_axis)
     x = residual + attn_out
 
     residual = x
     hidden = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
+    if tp_axis is not None:
+        hidden = tp_copy(hidden, tp_axis)
     gate = jax.nn.silu(hidden @ layer["mlp"]["gate"].astype(dt))
     up = hidden @ layer["mlp"]["up"].astype(dt)
-    x = residual + (gate * up) @ layer["mlp"]["down"].astype(dt)
-    return x
+    mlp_out = (gate * up) @ layer["mlp"]["down"].astype(dt)
+    if tp_axis is not None:
+        mlp_out = tp_reduce(mlp_out, tp_axis)
+    return residual + mlp_out
 
 
 def run_layers(
@@ -130,6 +153,7 @@ def run_layers(
     cfg: LlamaConfig,
     attn_fn: AttnFn = attention,
     remat: bool = False,
+    tp_axis: str | None = None,
 ) -> jnp.ndarray:
     """Apply a stack of layers (leading axis on every leaf) via lax.scan.
 
@@ -139,7 +163,8 @@ def run_layers(
     """
 
     def body(h, layer):
-        return decoder_layer(layer, h, padding_mask, cos, sin, cfg, attn_fn), None
+        return decoder_layer(layer, h, padding_mask, cos, sin, cfg, attn_fn,
+                             tp_axis=tp_axis), None
 
     if remat:
         body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)

@@ -1,0 +1,370 @@
+"""Pallas TPU flash attention (FlashAttention-2 schedule), with custom VJP.
+
+This is the long-context answer to the reference's O(L^2) materialized causal
+mask (reference data/flan.py:194-243) and its abandoned flash-attention
+attempt (reference README.md:141-143, `enable_flash_attention: False`): the
+causal predicate is evaluated in-kernel per tile, scores never exist in HBM,
+and memory is O(L) per head.
+
+Schedule: grid (batch, q_heads, q_blocks, kv_blocks); kv iterates innermost,
+carrying running max / sum / accumulator in VMEM scratch; fully-masked tiles
+are skipped with predication (`pl.when`); the normalized output and the
+logsumexp residual are written on the last kv step. Backward recomputes tile
+scores from the saved logsumexp (two kernels: dq over kv tiles; dk/dv over q
+tiles), per FlashAttention-2.
+
+Layouts: kernels run on [b, h, s, hd] (Mosaic wants the last two block dims
+to be (8k, 128k)-aligned or full), transposed in/out at the op boundary; the
+logsumexp/delta rows are [b, h, s, 1]. GQA derives the kv-head index inside
+the BlockSpec index_map (q_head // group), so grouped K/V are never
+materialized in the forward pass.
+
+Causal correctness with right-padded batches needs no padding mask: padding
+sits at positions AFTER every real token, so causal masking already excludes
+it as keys, and padded queries' outputs are dropped by the loss's
+IGNORE_INDEX masking (see ops/attention.py for the maskful reference path).
+
+`q_offset`/`kv_offset` shift the global positions of the local q/kv slabs —
+the hook ring attention (parallel/ring_attention.py) uses to run this same
+kernel on rotated KV blocks.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_INTERPRET = None  # overridden in tests; None -> auto (True off-TPU)
+
+
+def _interpret_mode() -> bool:
+    if _INTERPRET is not None:
+        return _INTERPRET
+    return jax.default_backend() != "tpu"
+
+
+def _block_sizes(sq: int, skv: int, block_q: int, block_k: int) -> tuple[int, int]:
+    bq = min(block_q, sq)
+    bk = min(block_k, skv)
+    if sq % bq or skv % bk:
+        raise ValueError(
+            f"sequence lengths (q={sq}, kv={skv}) must be divisible by the "
+            f"block sizes (q={bq}, kv={bk}); pad the batch to a block multiple")
+    return bq, bk
+
+
+def _causal_tile_mask(s, qi, ki, block_q, block_k, q_offset, kv_offset):
+    qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = kv_offset + ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(qpos >= kpos, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                acc_scr, *, scale, causal, block_q, block_k):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    n_k = pl.num_programs(3)
+    q_offset, kv_offset = offs_ref[0], offs_ref[1]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Tile visibility under the causal predicate (with global offsets):
+    # last q position in this tile must see at least the first kv position.
+    q_last = q_offset + (qi + 1) * block_q - 1
+    k_first = kv_offset + ki * block_k
+    run = (q_last >= k_first) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale  # [bq, hd]
+        k = k_ref[0, 0, :, :].astype(jnp.float32)          # [bk, hd]
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            s = _causal_tile_mask(s, qi, ki, block_q, block_k, q_offset, kv_offset)
+
+        m_prev = m_scr[:, :1]                                   # [bq, 1]
+        m_cur = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        correction = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)                                  # [bq, bk]
+        l_scr[:] = jnp.broadcast_to(
+            correction * l_scr[:, :1] + p.sum(axis=-1, keepdims=True), l_scr.shape)
+        acc_scr[:] = acc_scr[:] * correction + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0, 0, :, :] = jnp.where(
+            l > 0.0, acc_scr[:] / safe_l, 0.0).astype(o_ref.dtype)
+        # logsumexp residual for the backward pass; NEG_INF marks empty rows
+        lse_ref[0, 0, :, :] = jnp.where(
+            l > 0.0, m_scr[:, :1] + jnp.log(safe_l), NEG_INF)
+
+
+def _fwd(q, k, v, *, causal, scale, block_q, block_k, q_offset, kv_offset):
+    """q: [b, h, sq, hd]; k/v: [b, h_kv, skv, hd] -> out [b, h, sq, hd],
+    lse [b, h, sq, 1]."""
+    b, h, sq, hd = q.shape
+    h_kv, skv = k.shape[1], k.shape[2]
+    group = h // h_kv
+    bq, bk = _block_sizes(sq, skv, block_q, block_k)
+    n_q, n_k = sq // bq, skv // bk
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk)
+    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(kv_offset, jnp.int32)])
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, bq, hd), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, bk, hd), lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
+            pl.BlockSpec((1, 1, bk, hd), lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, hd), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, hd), jnp.float32),
+        ],
+        interpret=_interpret_mode(),
+    )(offsets, q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *, scale, causal, block_q, block_k):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    n_k = pl.num_programs(3)
+    q_offset, kv_offset = offs_ref[0], offs_ref[1]
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_last = q_offset + (qi + 1) * block_q - 1
+    k_first = kv_offset + ki * block_k
+    run = (q_last >= k_first) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :]                               # [bq, 1]
+        delta = delta_ref[0, 0, :, :]                           # [bq, 1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_tile_mask(s, qi, ki, block_q, block_k, q_offset, kv_offset)
+        p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_scr[:] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, block_q, block_k):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    n_q = pl.num_programs(3)
+    q_offset, kv_offset = offs_ref[0], offs_ref[1]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_last = q_offset + (qi + 1) * block_q - 1
+    k_first = kv_offset + ki * block_k
+    run = (q_last >= k_first) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :]
+        delta = delta_ref[0, 0, :, :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_tile_mask(s, qi, ki, block_q, block_k, q_offset, kv_offset)
+        p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)
+        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # q was loaded pre-scaled, so ds^T @ q already carries the 1/sqrt(hd)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k_full, v_full, delta, lse, do, *, causal, scale, block_q, block_k,
+         q_offset, kv_offset):
+    """All arrays [b, h, s, hd] (kv pre-expanded to full heads);
+    delta = rowsum(dO * O) [b, h, sq, 1] is computed by the caller (the ring
+    backward passes the GLOBAL delta for its slab-wise recompute)."""
+    b, h, sq, hd = q.shape
+    skv = k_full.shape[2]
+    bq, bk = _block_sizes(sq, skv, block_q, block_k)
+    n_q, n_k = sq // bq, skv // bk
+
+    common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk)
+    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(kv_offset, jnp.int32)])
+    smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    q_spec = pl.BlockSpec((1, 1, bq, hd), lambda b_, h_, qi, ki: (b_, h_, qi, 0))
+    k_spec = pl.BlockSpec((1, 1, bk, hd), lambda b_, h_, qi, ki: (b_, h_, ki, 0))
+    row_spec = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, qi, ki: (b_, h_, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(b, h, n_q, n_k),
+        in_specs=[smem_spec, q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, hd), jnp.float32)],
+        interpret=_interpret_mode(),
+    )(offsets, q, k_full, v_full, do, lse, delta)
+
+    # dk/dv: kv tiles outer, q tiles inner.
+    q_spec_t = pl.BlockSpec((1, 1, bq, hd), lambda b_, h_, ki, qi: (b_, h_, qi, 0))
+    k_spec_t = pl.BlockSpec((1, 1, bk, hd), lambda b_, h_, ki, qi: (b_, h_, ki, 0))
+    row_spec_t = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, ki, qi: (b_, h_, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(b, h, n_k, n_q),
+        in_specs=[smem_spec, q_spec_t, k_spec_t, k_spec_t, q_spec_t, row_spec_t,
+                  row_spec_t],
+        out_specs=[k_spec_t, k_spec_t],
+        out_shape=[jax.ShapeDtypeStruct(k_full.shape, k_full.dtype),
+                   jax.ShapeDtypeStruct(v_full.shape, v_full.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, hd), jnp.float32),
+                        pltpu.VMEM((bk, hd), jnp.float32)],
+        interpret=_interpret_mode(),
+    )(offsets, q, k_full, v_full, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public op with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, q_offset, kv_offset):
+    out, _ = _fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
+                  block_k=block_k, q_offset=q_offset, kv_offset=kv_offset)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, q_offset, kv_offset):
+    out, lse = _fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
+                    block_k=block_k, q_offset=q_offset, kv_offset=kv_offset)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, q_offset, kv_offset, res, do):
+    q, k, v, out, lse = res
+    h, h_kv = q.shape[1], k.shape[1]
+    group = h // h_kv
+    # Backward materializes grouped KV at full heads (forward never does);
+    # group reduction of dk/dv happens outside the kernel.
+    k_full = jnp.repeat(k, group, axis=1) if group > 1 else k
+    v_full = jnp.repeat(v, group, axis=1) if group > 1 else v
+    # delta_i = rowsum(dO_i * O_i) — cheap elementwise, XLA's job.
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [b, h, sq, 1]
+    dq, dk_full, dv_full = _bwd(
+        q, k_full, v_full, delta, lse, do, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, q_offset=q_offset, kv_offset=kv_offset)
+    if group > 1:
+        b, _, skv, hd = dk_full.shape
+        dk = dk_full.reshape(b, h_kv, group, skv, hd).sum(axis=2).astype(k.dtype)
+        dv = dv_full.reshape(b, h_kv, group, skv, hd).sum(axis=2).astype(v.dtype)
+    else:
+        dk, dv = dk_full, dv_full
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    padding_mask: Any = None,
+    *,
+    causal: bool = True,
+    q_offset: int = 0,
+    kv_offset: int = 0,
+    block_q: int = 1024,
+    block_k: int = 1024,
+) -> jnp.ndarray:
+    """Drop-in AttnFn (same [b, s, h, hd] signature as ops.attention.attention).
+
+    padding_mask is accepted for interface parity but ignored: with causal
+    attention and right-padded batches it is mathematically redundant (see
+    module docstring). Pass left-padded or non-causal workloads to the
+    reference path instead.
+    """
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(f"q heads {q.shape[2]} not a multiple of kv heads {k.shape[2]}")
+    scale = q.shape[-1] ** -0.5
+    # kernels run on [b, h, s, hd]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash(qt, kt, vt, causal, scale, block_q, block_k, q_offset, kv_offset)
+    return out.transpose(0, 2, 1, 3)

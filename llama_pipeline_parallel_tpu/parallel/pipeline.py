@@ -55,7 +55,7 @@ from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
 from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
 from llama_pipeline_parallel_tpu.ops.attention import attention
 from llama_pipeline_parallel_tpu.ops.rope import rope_cos_sin
-from llama_pipeline_parallel_tpu.parallel.mesh import AXIS_DP, AXIS_PP
+from llama_pipeline_parallel_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_TP
 
 Params = dict
 Batch = dict
@@ -104,11 +104,19 @@ def unstack_stages(params: Params, manifest: StageManifest) -> Params:
     return out
 
 
-def stage_param_specs(params: Params) -> Params:
+def stage_param_specs(params: Params, tp: bool = False) -> Params:
     """PartitionSpec tree for stage-stacked params: layer leaves sharded over
-    pp on the stage axis, embed/norm/head replicated."""
+    pp on the stage axis, embed/norm/head replicated.
+
+    With `tp`, matmul weights additionally shard Megatron-style over the tp
+    axis: qkv/gate/up column-parallel (output dim), wo/down row-parallel
+    (input dim); norms stay replicated over tp."""
     specs = jax.tree.map(lambda _: P(), params)
     specs["layers"] = jax.tree.map(lambda _: P(AXIS_PP), params["layers"])
+    if tp:
+        col, row = P(AXIS_PP, None, None, AXIS_TP), P(AXIS_PP, None, AXIS_TP, None)
+        specs["layers"]["attn"] = {"wq": col, "wk": col, "wv": col, "wo": row}
+        specs["layers"]["mlp"] = {"gate": col, "up": col, "down": row}
     return specs
 
 
@@ -178,8 +186,9 @@ def _pipeline_loss_local(
             pad_mask = None
         cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta, dtype=cfg.dtype)
 
+        tp_axis = AXIS_TP if jax.lax.axis_size(AXIS_TP) > 1 else None
         y = llama.run_layers(local_layers, x_in, pad_mask, cos, sin, cfg,
-                             attn_fn=attn_fn, remat=pcfg.remat)
+                             attn_fn=attn_fn, remat=pcfg.remat, tp_axis=tp_axis)
 
         # Collect the last stage's finished microbatch; everyone else (and
         # warmup ticks) writes to the discard slot.
@@ -260,12 +269,19 @@ def make_pipeline_loss_and_grad(
         raise ValueError(
             f"PipelineConfig.num_stages={pcfg.num_stages} does not match the "
             f"mesh pp axis size {mesh.shape[AXIS_PP]}")
-    for axis in ("sp", "tp"):
-        if mesh.shape[axis] != 1:
+    if mesh.shape["sp"] != 1:
+        raise ValueError(
+            f"sp>1 is not wired into the pipeline loss yet (mesh sp="
+            f"{mesh.shape['sp']}); use parallel/ring_attention.py standalone")
+    tp = mesh.shape[AXIS_TP]
+    if tp > 1:
+        if cfg.kv_heads % tp or cfg.num_attention_heads % tp:
             raise ValueError(
-                f"{axis}>1 is not wired into the pipeline loss yet "
-                f"(mesh {axis}={mesh.shape[axis]}); use {axis}=1")
-    param_specs = stage_param_specs(params_like)
+                f"tp={tp} must divide both num_attention_heads="
+                f"{cfg.num_attention_heads} and kv_heads={cfg.kv_heads}")
+        if cfg.intermediate_size % tp:
+            raise ValueError(f"tp={tp} must divide intermediate_size={cfg.intermediate_size}")
+    param_specs = stage_param_specs(params_like, tp=tp > 1)
     batch_specs = {
         "input_ids": P(AXIS_DP), "attention_mask": P(AXIS_DP),
         "position_ids": P(AXIS_DP), "labels": P(AXIS_DP),

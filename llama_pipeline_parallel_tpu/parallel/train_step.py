@@ -44,17 +44,22 @@ class TrainState(NamedTuple):
 # ---------------------------------------------------------------------------
 
 def _zero1_leaf_spec(param_spec: P, shape: tuple[int, ...], dp_size: int) -> P:
-    """Extend a param's spec with dp sharding on its last dim (if it divides).
+    """Extend a param's spec with dp sharding on its rightmost free dim.
 
-    Sharding the trailing (feature) dim keeps the stage axis layout intact and
-    divides evenly for every matmul weight; small vectors stay replicated.
+    Scans from the trailing (feature) dim backwards so tp-sharded weights
+    (whose last dim already carries 'tp') still get their moments dp-sharded
+    on another dim — otherwise a pp x tp x dp run would silently keep the
+    column-parallel moments (most of the bytes) dp-replicated. The leading
+    stage dim (index 0 of stacked leaves, 'pp') is never touched.
     """
-    if len(shape) < 2 or dp_size == 1 or shape[-1] % dp_size:
+    if len(shape) < 2 or dp_size == 1:
         return param_spec
     spec = list(param_spec) + [None] * (len(shape) - len(param_spec))
-    if spec[-1] is None:
-        spec[-1] = AXIS_DP
-    return P(*spec)
+    for dim in range(len(shape) - 1, 0, -1):
+        if spec[dim] is None and shape[dim] % dp_size == 0:
+            spec[dim] = AXIS_DP
+            return P(*spec)
+    return param_spec
 
 
 def zero1_opt_state_specs(
@@ -90,7 +95,7 @@ def zero1_opt_state_specs(
 def state_shardings(mesh: Mesh, tx: optax.GradientTransformation, params_like: Params
                     ) -> TrainState:
     """NamedSharding tree for the full TrainState."""
-    param_specs = stage_param_specs(params_like)
+    param_specs = stage_param_specs(params_like, tp=mesh.shape["tp"] > 1)
     opt_specs = zero1_opt_state_specs(tx, params_like, param_specs, mesh.shape[AXIS_DP])
     to_sharding = lambda spec: NamedSharding(mesh, spec)
     return TrainState(

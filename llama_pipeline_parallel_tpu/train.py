@@ -94,6 +94,33 @@ def build_dataset_and_collator(cfg: dict, model_cfg: LlamaConfig) -> tuple[Any, 
     return ds, collator
 
 
+def select_attention(impl: str, seq_length: int, mesh) -> Any:
+    """'exact' | 'flash' | 'auto'. The reference tried and failed to enable
+    flash attention (README.md:141-143); here it is the default for long
+    sequences on TPU, where the exact path's O(L^2) scores dominate.
+
+    `seq_length` must be the ACTUAL batch sequence length (probe the
+    collator), not a config guess. `auto` falls back to the exact path when
+    the length does not tile into the flash kernel's blocks."""
+    from llama_pipeline_parallel_tpu.ops.attention import attention
+    from llama_pipeline_parallel_tpu.ops.flash_attention import flash_attention
+
+    if impl == "exact":
+        return attention
+    if impl == "flash":
+        return flash_attention
+    if impl == "auto":
+        on_tpu = mesh.devices.ravel()[0].platform == "tpu"
+        tiles = seq_length % min(1024, seq_length) == 0 and seq_length % 128 == 0
+        if on_tpu and seq_length >= 2048 and not tiles:
+            logger.warning(
+                "attention=auto: seq_length=%d does not tile into flash blocks; "
+                "using the exact path (pad to a 1024 multiple to enable flash)",
+                seq_length)
+        return flash_attention if (on_tpu and seq_length >= 2048 and tiles) else attention
+    raise ValueError(f"unknown attention impl {impl!r} (use exact|flash|auto)")
+
+
 def run_training(cfg: dict) -> dict:
     """The full training run; returns a summary dict for programmatic callers."""
     seed = cfg.get("seed", 42)
@@ -168,12 +195,14 @@ def run_training(cfg: dict) -> dict:
             opt_state=state.opt_state)
         logger.info("warm-started module weights from %s", cfg["model_name_or_path"])
 
-    step_fn = ts.make_train_step(mesh, model_cfg, pcfg, tx, schedule, stacked_template)
+    seq_length = int(collator([dataset[0]])["input_ids"].shape[1])
+    attn_fn = select_attention(cfg.get("attention", "auto"), seq_length, mesh)
+    step_fn = ts.make_train_step(mesh, model_cfg, pcfg, tx, schedule,
+                                 stacked_template, attn_fn=attn_fn)
 
     # ---- loop -------------------------------------------------------------
     writer = MetricsWriter(output_dir, config_snapshot=cfg,
                            use_wandb=cfg.get("use_wandb", False))
-    seq_length = int(collator([dataset[0]])["input_ids"].shape[1])
     meter = Throughput(model_cfg, seq_length, n_chips=mesh.devices.size)
     logging_steps = cfg.get("logging_steps", 10)
     save_steps = cfg.get("save_steps", 0)
