@@ -16,7 +16,7 @@ Re-implements the reference's `main()` + `train()` orchestration
 
 from __future__ import annotations
 
-import time
+import os
 from typing import Any, Iterator
 
 import jax
@@ -111,7 +111,7 @@ def select_attention(impl: str, seq_length: int, mesh) -> Any:
         return flash_attention
     if impl == "auto":
         on_tpu = mesh.devices.ravel()[0].platform == "tpu"
-        tiles = seq_length % min(1024, seq_length) == 0 and seq_length % 128 == 0
+        tiles = seq_length % 1024 == 0  # must divide the flash block size
         if on_tpu and seq_length >= 2048 and not tiles:
             logger.warning(
                 "attention=auto: seq_length=%d does not tile into flash blocks; "
@@ -170,6 +170,10 @@ def run_training(cfg: dict) -> dict:
     stacked_template = pl.stack_stages(params, manifest)
     mgr = CheckpointManager(output_dir)
 
+    if cfg.get("optimizer_offload"):
+        return _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg,
+                            dataset, collator, loader, end_step, stacked_template, mgr)
+
     resume_step = 0
     resume = mgr.latest_step() if cfg.get("resume", True) else None
     state = ts.init_train_state(stacked_template, tx, mesh)
@@ -201,38 +205,147 @@ def run_training(cfg: dict) -> dict:
                                  stacked_template, attn_fn=attn_fn)
 
     # ---- loop -------------------------------------------------------------
+    state_box = [state]
+
+    def do_step(batch):
+        new_state, metrics = step_fn(state_box[0],
+                                     {k: jnp.asarray(v) for k, v in batch.items()})
+        state_box[0] = new_state
+        return metrics["loss"], lambda: {"lr": float(metrics["lr"]),
+                                         "grad_norm": float(metrics["grad_norm"])}
+
+    def do_save(step):
+        mgr.save(step, state_box[0].params, manifest, model_cfg,
+                 opt_state=state_box[0].opt_state)
+
+    final_loss = _train_loop(cfg, model_cfg, mesh, loader, seq_length,
+                             resume_step, end_step, do_step, do_save)
+    return {"final_step": end_step, "final_loss": final_loss,
+            "steps_per_epoch": steps_per_epoch, "output_dir": output_dir}
+
+
+def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
+                do_step, do_save) -> float:
+    """The shared step/log/save/profile loop for both optimizer paths.
+
+    `do_step(batch) -> (loss_scalar, scalars_thunk)`; the thunk is only called
+    at logging boundaries so the hot loop never blocks on a D2H sync.
+    `do_save(step)` writes a full checkpoint.
+    """
+    output_dir = cfg["output_dir"]
     writer = MetricsWriter(output_dir, config_snapshot=cfg,
                            use_wandb=cfg.get("use_wandb", False))
     meter = Throughput(model_cfg, seq_length, n_chips=mesh.devices.size)
     logging_steps = cfg.get("logging_steps", 10)
     save_steps = cfg.get("save_steps", 0)
 
+    # Optional profiler capture window: profile_steps: [start, stop] writes a
+    # tensorboard/Perfetto trace under <output_dir>/profile (SURVEY.md §5.1 —
+    # the reference had only DeepSpeed's steps_per_print throughput line).
+    # Clamped into [resume_step, end_step] so resume/short runs stay safe.
+    profile_window = cfg.get("profile_steps")
+    trace_active = False
+
     it: Iterator = iter(RepeatingLoader(loader))
     for _ in range(resume_step):  # dataloader fast-forward (reference :345-351)
         next(it)
 
-    losses: list = []  # jax scalars; fetched only at logging boundaries so the
-    final_loss = float("nan")  # hot loop never blocks on a per-step D2H sync
+    losses: list = []  # jax scalars; fetched only at logging boundaries
+    final_loss = float("nan")
     last_saved = -1
     for step in range(resume_step, end_step):
+        if profile_window and not trace_active and step >= profile_window[0] \
+                and step < profile_window[1]:
+            jax.profiler.start_trace(os.path.join(output_dir, "profile"))
+            trace_active = True
         batch = next(it)
-        state, metrics = step_fn(state, {k: jnp.asarray(v) for k, v in batch.items()})
-        losses.append(metrics["loss"])
+        loss, scalars_thunk = do_step(batch)
+        if trace_active and (step + 1 >= profile_window[1] or step + 1 == end_step):
+            jax.block_until_ready(loss)
+            jax.profiler.stop_trace()
+            trace_active = False
+            logger.info("profiler trace written to %s/profile", output_dir)
+        losses.append(loss)
         meter.update(batch["input_ids"].size)
         if (step + 1) % logging_steps == 0 or step + 1 == end_step:
             final_loss = float(losses[-1])
-            scalars = {"loss": float(np.mean([float(l) for l in losses])),
-                       "lr": float(metrics["lr"]),
-                       "grad_norm": float(metrics["grad_norm"]),
-                       **meter.read_and_reset()}
-            writer.log(step + 1, scalars)
+            writer.log(step + 1, {"loss": float(np.mean([float(l) for l in losses])),
+                                  **scalars_thunk(), **meter.read_and_reset()})
             losses.clear()
         if save_steps and (step + 1) % save_steps == 0:
-            mgr.save(step + 1, state.params, manifest, model_cfg,
-                     opt_state=state.opt_state)
+            do_save(step + 1)
             last_saved = step + 1
     if cfg.get("save_final", True) and last_saved != end_step:
-        mgr.save(end_step, state.params, manifest, model_cfg, opt_state=state.opt_state)
+        do_save(end_step)
     writer.close()
+    return final_loss
+
+
+def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
+                 loader, end_step, stacked_template, mgr) -> dict:
+    """Host-offloaded-optimizer training setup (reference ZeRO-offload path,
+    conf yaml:160-162): fp32 masters + Adam moments in host DRAM via
+    optim/offload.py; the device holds only the bf16 working copy and runs
+    loss+grad. Grads stream D2H, fresh bf16 params H2D, every step."""
+    from jax.sharding import NamedSharding
+    from llama_pipeline_parallel_tpu.optim.offload import HostOffloadAdamW
+
+    output_dir = cfg["output_dir"]
+    host = HostOffloadAdamW(ocfg)
+    host.init(stacked_template)
+
+    resume_step = 0
+    resume = mgr.latest_step() if cfg.get("resume", True) else None
+    if resume is not None:
+        try:
+            p, o, resume_step = mgr.load(resume, stacked_template, host.state_dict(),
+                                         manifest)
+        except ValueError as e:
+            if not mgr.load_meta(resume).get("has_optimizer_state"):
+                raise  # accurate module-only message from CheckpointManager.load
+            raise ValueError(
+                f"checkpoint-{resume}'s optimizer state does not match the "
+                f"host-offload layout — it was probably written by the fused "
+                f"(optax) optimizer. To continue those weights under the "
+                f"offloaded optimizer, point model_name_or_path at this "
+                f"checkpoint and use a fresh output_dir (module-only warm "
+                f"start; optimizer moments restart).") from e
+        host.load_masters(p)
+        host.load_state_dict(o)
+        logger.info("resumed offloaded state from checkpoint-%d", resume_step)
+    elif cfg.get("model_name_or_path"):
+        warm = CheckpointManager(cfg["model_name_or_path"])
+        warm_step = warm.latest_step()
+        if warm_step is None:
+            raise FileNotFoundError(f"no checkpoint under {cfg['model_name_or_path']}")
+        host.load_masters(warm.load_params(warm_step, stacked_template, manifest))
+        logger.info("warm-started offloaded masters from %s", cfg["model_name_or_path"])
+
+    param_specs = pl.stage_param_specs(stacked_template, tp=mesh.shape["tp"] > 1)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs,
+                             is_leaf=lambda x: not isinstance(x, dict))
+    to_device = jax.jit(lambda p: llama.cast_params(p, model_cfg.dtype),
+                        out_shardings=shardings)
+
+    seq_length = int(collator([dataset[0]])["input_ids"].shape[1])
+    attn_fn = select_attention(cfg.get("attention", "auto"), seq_length, mesh)
+    grad_fn = jax.jit(pl.make_pipeline_loss_and_grad(
+        mesh, model_cfg, pcfg, stacked_template, attn_fn=attn_fn))
+
+    device_params_box = [to_device(host.params_tree)]
+
+    def do_step(batch):
+        loss, grads = grad_fn(device_params_box[0],
+                              {k: jnp.asarray(v) for k, v in batch.items()})
+        host.update(grads)
+        device_params_box[0] = to_device(host.params_tree)
+        return loss, lambda: {"lr": host.last_lr, "grad_norm": host.last_grad_norm}
+
+    def do_save(step):
+        mgr.save(step, host.params_tree, manifest, model_cfg,
+                 opt_state=host.state_dict())
+
+    final_loss = _train_loop(cfg, model_cfg, mesh, loader, seq_length,
+                             resume_step, end_step, do_step, do_save)
     return {"final_step": end_step, "final_loss": final_loss,
-            "steps_per_epoch": steps_per_epoch, "output_dir": output_dir}
+            "steps_per_epoch": len(loader), "output_dir": output_dir}

@@ -1,0 +1,87 @@
+"""Host-offloaded AdamW (C++ kernel) vs optax numerics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llama_pipeline_parallel_tpu.optim import OptimizerConfig, make_optimizer
+from llama_pipeline_parallel_tpu.optim import offload as off
+
+
+@pytest.fixture(scope="module")
+def tree():
+    rng = np.random.RandomState(0)
+    return {"a": jnp.asarray(rng.randn(64, 32), jnp.float32),
+            "b": {"c": jnp.asarray(rng.randn(128), jnp.float32)}}
+
+
+def grads_like(tree, seed):
+    rng = np.random.RandomState(seed)
+    return jax.tree.map(lambda x: jnp.asarray(rng.randn(*x.shape) * 2, jnp.float32), tree)
+
+
+def test_native_kernel_compiles():
+    assert off._load_native() is not None, "g++ compile of csrc/host_adamw.cpp failed"
+
+
+@pytest.mark.parametrize("force_numpy", [False, True])
+def test_matches_optax(tree, force_numpy, monkeypatch):
+    if force_numpy:
+        monkeypatch.setattr(off, "_lib", None)
+        monkeypatch.setattr(off, "_lib_failed", True)
+    cfg = OptimizerConfig(learning_rate=1e-2, weight_decay=0.1, beta1=0.9,
+                          beta2=0.95, max_grad_norm=1.0, total_steps=100,
+                          warmup_steps=10)
+    tx, _ = make_optimizer(cfg)
+    opt_state = tx.init(tree)
+    params_ref = tree
+
+    host = off.HostOffloadAdamW(cfg)
+    host.init(tree)
+
+    for step in range(5):
+        g = grads_like(tree, step)
+        updates, opt_state = tx.update(g, opt_state, params_ref)
+        import optax
+
+        params_ref = optax.apply_updates(params_ref, updates)
+        params_host = host.update(g)
+
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+        params_ref, params_host)
+    assert host.last_grad_norm > 0
+
+
+def test_state_dict_roundtrip(tree):
+    cfg = OptimizerConfig(learning_rate=1e-2, total_steps=50, warmup_steps=2)
+    h1 = off.HostOffloadAdamW(cfg)
+    h1.init(tree)
+    h1.update(grads_like(tree, 0))
+    state = h1.state_dict()
+
+    h2 = off.HostOffloadAdamW(cfg)
+    h2.init(tree)
+    h2.load_state_dict(state)
+    p1 = h1.update(grads_like(tree, 1))
+    # h2 params must be synced to h1's before the next step for equality
+    h2._params = [p.copy() for p in h1._params]
+    # re-do: start both from identical params/moments
+    h1b = off.HostOffloadAdamW(cfg); h1b.init(tree)
+    h1b.update(grads_like(tree, 0))
+    h2b = off.HostOffloadAdamW(cfg); h2b.init(tree)
+    h2b.load_state_dict(h1b.state_dict())
+    h2b._params = [p.copy() for p in h1b._params]
+    a = h1b.update(grads_like(tree, 1))
+    b = h2b.update(grads_like(tree, 1))
+    jax.tree.map(lambda x, y: np.testing.assert_allclose(
+        np.asarray(x), np.asarray(y), rtol=0, atol=0), a, b)
+
+
+def test_mismatched_tree_raises(tree):
+    cfg = OptimizerConfig(total_steps=10, warmup_steps=1)
+    h = off.HostOffloadAdamW(cfg)
+    h.init(tree)
+    with pytest.raises(ValueError, match="does not match"):
+        h.update({"a": jnp.zeros((64, 32))})
