@@ -85,12 +85,19 @@ class DataLoader:
     dp_size: int = 1
     shuffle: bool = True
     seed: int = 0
+    # multi-host: which dp replicas THIS process materializes (from
+    # parallel.distributed.host_dp_shard); None = all of them
+    dp_range: tuple[int, int] | None = None
 
     def __post_init__(self) -> None:
+        first, count = self.dp_range if self.dp_range is not None else (0, self.dp_size)
+        if not (0 <= first and first + count <= self.dp_size):
+            raise ValueError(f"dp_range {self.dp_range} outside dp_size {self.dp_size}")
+        self._local_dp = range(first, first + count)
         self._samplers = [
             ShardedSampler(len(self.dataset), self.dp_size, rank=d,
                            shuffle=self.shuffle, seed=self.seed)
-            for d in range(self.dp_size)
+            for d in self._local_dp
         ]
 
     def set_epoch(self, epoch: int) -> None:
@@ -105,8 +112,9 @@ class DataLoader:
         per_replica = [s.indices() for s in self._samplers]
         for b in range(len(self)):
             rows = []
-            for d in range(self.dp_size):
-                sl = per_replica[d][b * self.per_replica_batch:(b + 1) * self.per_replica_batch]
+            for local_idx, _ in enumerate(self._local_dp):
+                sl = per_replica[local_idx][
+                    b * self.per_replica_batch:(b + 1) * self.per_replica_batch]
                 rows.extend(self.dataset[int(i)] for i in sl)
             yield self.collate_fn(rows)
 

@@ -1,0 +1,108 @@
+"""Multi-host runtime: process init, global-batch assembly, host barriers.
+
+Replaces the reference's process-group bring-up and barrier discipline
+(`deepspeed.init_distributed(dist_backend="nccl", timeout=7200s)` reference
+trainer_base_ds_mp.py:399 and the `dist.barrier()` sites :163-223,413-434):
+on TPU pods there is no NCCL and no rendezvous timeout tuning — ICI/DCN
+transport is owned by the XLA runtime; the host side only needs
+`jax.distributed.initialize()` once per process plus an occasional
+all-process sync around filesystem phases (checkpoint commit).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from llama_pipeline_parallel_tpu.parallel.mesh import AXIS_DP
+from llama_pipeline_parallel_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+_initialized = False
+
+_COORDINATOR_ENVS = ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+                     "MEGASCALE_COORDINATOR_ADDRESS")
+
+
+def initialize_distributed() -> None:
+    """Per-host runtime init — call once, BEFORE any device query (a device
+    query commits the local backend and makes a later initialize() fail).
+
+    Initialization only happens when a coordinator is configured in the
+    environment (TPU-pod launchers set one of the standard variables);
+    plain single-host runs skip it entirely.
+    """
+    global _initialized
+    if _initialized:
+        return
+    _initialized = True
+    if not any(os.environ.get(k) for k in _COORDINATOR_ENVS):
+        return  # single-host run: nothing to initialize
+    jax.distributed.initialize()
+    logger.info("jax.distributed initialized: process %d/%d",
+                jax.process_index(), jax.process_count())
+
+
+def barrier(tag: str = "sync") -> None:
+    """All-process host barrier (reference dist.barrier equivalents) — used
+    around host-side phases like checkpoint commit; device-side ordering
+    needs none (it is data dependencies inside jit)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
+
+
+def form_global_batch(mesh: Mesh, host_batch: Mapping[str, np.ndarray]) -> dict:
+    """Assemble the global dp-sharded batch from per-host data.
+
+    Single-process: the host batch IS the global batch (placed sharded).
+    Multi-host: each process loads only its processes' dp shards (rows
+    [dp_rank_of_host * per_replica : ...]) and the global jax.Array is formed
+    from process-local shards without any cross-host gather — the TPU-world
+    equivalent of the reference's rule that only data-consuming ranks run
+    real DataLoaders (reference README.md:64-129).
+    """
+    sharding = NamedSharding(mesh, P(AXIS_DP))
+    if jax.process_count() == 1:
+        return {k: jax.device_put(np.asarray(v), sharding)
+                for k, v in host_batch.items()}
+    from jax.experimental import multihost_utils
+
+    return {
+        k: multihost_utils.host_local_array_to_global_array(
+            np.asarray(v), mesh, P(AXIS_DP))
+        for k, v in host_batch.items()
+    }
+
+
+def host_dp_shard(mesh: Mesh) -> tuple[int, int]:
+    """(first_dp_index, count) of the dp replicas THIS process must load data
+    for. The DataLoader materializes only those replicas' rows; the global
+    batch is then assembled from per-process shards by `form_global_batch`.
+    Single-process: the whole dp range.
+    """
+    dp_size = mesh.shape[AXIS_DP]
+    if jax.process_count() == 1:
+        return 0, dp_size
+    local = set()
+    dp_axis_index = list(mesh.axis_names).index(AXIS_DP)
+    for d in jax.local_devices():
+        coords = np.argwhere(mesh.devices == d)
+        if coords.size:
+            local.add(int(coords[0][dp_axis_index]))
+    if not local:
+        return 0, dp_size
+    first, count = min(local), len(local)
+    if set(range(first, first + count)) != local:
+        raise ValueError(
+            f"this host's devices span non-contiguous dp shards {sorted(local)}; "
+            f"the mesh layout must keep each host's dp coordinates contiguous")
+    return first, count

@@ -45,10 +45,21 @@ def _set_path(root: dict, dotted: str, value: Any) -> None:
 
 
 def _parse_scalar(text: str) -> Any:
+    """Parse an override VALUE: YAML first (covers numbers, bools, lists, and
+    inline dicts like `{synthetic: true}`), Python literal as fallback.
+
+    YAML 1.1 leaves dot-less exponent floats ('1e-4') as strings — coerce
+    them explicitly, matching what `load_config` does for file values."""
     try:
-        return ast.literal_eval(text)
-    except (ValueError, SyntaxError):
-        return text
+        value = yaml.safe_load(text)
+    except yaml.YAMLError:
+        try:
+            return ast.literal_eval(text)
+        except (ValueError, SyntaxError):
+            return text
+    if isinstance(value, str) and _SCI_FLOAT_RE.fullmatch(value):
+        return float(value)
+    return value
 
 
 def _resolve(node: Any, root: Any, seen: tuple[str, ...] = ()) -> Any:

@@ -72,6 +72,17 @@ def test_offload_loop_runs_and_resumes(tmp_path, devices):
     np.testing.assert_allclose(resumed["final_loss"], straight["final_loss"], rtol=1e-5)
 
 
+def test_eval_loop(tmp_path, devices):
+    cfg = base_cfg(tmp_path, eval_steps=2,
+                   eval_dataset={"synthetic": True, "seq_length": 16,
+                                 "pseudo_dataset_len": 16})
+    summary = run_training(cfg)
+    lines = [json.loads(l) for l in
+             open(os.path.join(summary["output_dir"], "metrics.jsonl"))]
+    evals = [l for l in lines if "eval_loss" in l]
+    assert len(evals) == 2 and all(np.isfinite(l["eval_loss"]) for l in evals)
+
+
 def test_shipped_configs_parse():
     for name in ("tiny_smoke", "llama_7b_pp4", "llama_65b_pp8_dp4"):
         cfg = load_config(f"conf/{name}.yaml")
