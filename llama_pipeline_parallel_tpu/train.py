@@ -223,8 +223,9 @@ def run_training(cfg: dict) -> dict:
 
     def do_save(step):
         barrier("pre-save")
-        mgr.save(step, state_box[0].params, manifest, model_cfg,
-                 opt_state=state_box[0].opt_state)
+        path = mgr.save(step, state_box[0].params, manifest, model_cfg,
+                        opt_state=state_box[0].opt_state)
+        _sync_checkpoint(cfg, path)
 
     do_eval = _make_evaluator(cfg, mesh, model_cfg, pcfg, stacked_template,
                               attn_fn, lambda: state_box[0].params)
@@ -232,6 +233,32 @@ def run_training(cfg: dict) -> dict:
                              resume_step, end_step, do_step, do_save, do_eval)
     return {"final_step": end_step, "final_loss": final_loss,
             "steps_per_epoch": steps_per_epoch, "output_dir": output_dir}
+
+
+def _sync_checkpoint(cfg: dict, path: str) -> None:
+    """Off-node durability hook (reference `./s5cmd sync` after each save,
+    trainer_base_ds_mp.py:220): run `save_sync_command` with {path}
+    substituted, on process 0, after the checkpoint is durably on disk.
+    e.g.  save_sync_command: "gsutil -m rsync -r {path} gs://bucket/run/"
+    Failures are logged, never fatal — a sync outage must not kill training.
+    """
+    command = cfg.get("save_sync_command")
+    if not command or jax.process_index() != 0:
+        return
+    import subprocess
+
+    # plain replace (not str.format): the command may contain shell braces
+    cmd = command.replace("{path}", path)
+    try:
+        result = subprocess.run(cmd, shell=True, capture_output=True, text=True,
+                                timeout=cfg.get("save_sync_timeout", 1800))
+        if result.returncode != 0:
+            logger.warning("save_sync_command failed (%d): %s", result.returncode,
+                           result.stderr.strip()[-500:])
+        else:
+            logger.info("checkpoint synced: %s", cmd)
+    except Exception as e:  # timeout / spawn failure — never kill training
+        logger.warning("save_sync_command error: %r", e)
 
 
 def _make_evaluator(cfg, mesh, model_cfg, pcfg, stacked_template, attn_fn,
@@ -390,8 +417,9 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
 
     def do_save(step):
         barrier("pre-save")
-        mgr.save(step, host.params_tree, manifest, model_cfg,
-                 opt_state=host.state_dict())
+        path = mgr.save(step, host.params_tree, manifest, model_cfg,
+                        opt_state=host.state_dict())
+        _sync_checkpoint(cfg, path)
 
     do_eval = _make_evaluator(cfg, mesh, model_cfg, pcfg, stacked_template,
                               attn_fn, lambda: device_params_box[0])

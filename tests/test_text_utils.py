@@ -1,0 +1,52 @@
+from llama_pipeline_parallel_tpu.data.text_utils import (
+    char_to_token_spans,
+    chunk_by_spans,
+    find_spans,
+    get_unused_tokens,
+    resolve_spans,
+    word_tokenize,
+)
+
+
+def test_find_spans_word_boundaries():
+    text = "the cat scattered the cats"
+    assert find_spans(text, "cat") == [(4, 7)]  # not inside "scattered"/"cats"
+    assert find_spans(text, "cats") == [(22, 26)]
+    assert find_spans(text, "") == []
+
+
+def test_resolve_spans_nested_and_overlap():
+    # nested span dropped, partial overlap clipped
+    assert resolve_spans([(0, 10), (2, 5)]) == [(0, 10)]
+    assert resolve_spans([(0, 6), (4, 9)]) == [(0, 6), (6, 9)]
+
+
+def test_chunk_by_spans_indicator():
+    text = "Johann Wolfgang Goethe studied in Leipzig"
+    pieces, mask = chunk_by_spans(text, ["Johann Wolfgang Goethe", "Leipzig"])
+    assert pieces == ["Johann Wolfgang Goethe", "studied in", "Leipzig"]
+    assert mask == [1, 0, 1]
+    pieces2, mask2 = chunk_by_spans(text, ["Leipzig"], word_split=True)
+    assert pieces2[-1] == "Leipzig" and mask2[-1] == 1
+    assert mask2[:-1] == [0] * (len(pieces2) - 1)
+
+
+def test_word_tokenize_contractions():
+    assert word_tokenize("don't stop, now!") == ["don't", "stop", ",", "now", "!"]
+
+
+def test_get_unused_tokens():
+    class Tok:
+        def get_vocab(self):
+            return {"[unused0]": 1}
+
+    toks = get_unused_tokens(Tok(), num=2)
+    assert toks == ["[unused1]", "[unused2]"]
+
+
+def test_char_to_token_spans():
+    # "hello world" -> tokens [hello][ world] with offsets
+    offsets = [(0, 0), (0, 5), (5, 11)]  # leading special token
+    assert char_to_token_spans(offsets, [(0, 5)]) == [(1, 2)]
+    assert char_to_token_spans(offsets, [(6, 11)]) == [(2, 3)]
+    assert char_to_token_spans(offsets, [(100, 105)]) == [(0, 0)]
