@@ -154,12 +154,16 @@ def run_layers(
     attn_fn: AttnFn = attention,
     remat: bool = False,
     tp_axis: str | None = None,
+    remat_policy: str = "nothing_saveable",
 ) -> jnp.ndarray:
     """Apply a stack of layers (leading axis on every leaf) via lax.scan.
 
     `remat=True` recomputes each layer in backward — the analogue of
     `deepspeed.checkpointing.checkpoint` per layer (reference
     models/llama_ds_mp_wrap.py:57,166; flag conf yaml `activation_checkpointing`).
+    `remat_policy` trades recompute FLOPs for memory: `nothing_saveable`
+    (max memory savings), `dots_saveable` / `dots_with_no_batch_dims_saveable`
+    (keep matmul outputs, recompute only elementwise — cheaper backward).
     """
 
     def body(h, layer):
@@ -167,7 +171,10 @@ def run_layers(
                              tp_axis=tp_axis), None
 
     if remat:
-        body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+        policy = getattr(jax.checkpoint_policies, remat_policy, None)
+        if policy is None:
+            raise ValueError(f"unknown remat_policy {remat_policy!r}")
+        body = jax.checkpoint(body, policy=policy)
     x, _ = jax.lax.scan(body, x, layers)
     return x
 

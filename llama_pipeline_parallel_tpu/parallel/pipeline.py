@@ -69,12 +69,17 @@ class PipelineConfig:
     num_stages: int
     num_microbatches: int
     remat: bool = True
+    remat_policy: str = "nothing_saveable"
 
     def __post_init__(self) -> None:
         if self.num_microbatches < 1:
             raise ValueError("num_microbatches must be >= 1")
         if self.num_stages < 1:
             raise ValueError("num_stages must be >= 1")
+        if getattr(jax.checkpoint_policies, self.remat_policy, None) is None:
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r}; see "
+                f"jax.checkpoint_policies (e.g. nothing_saveable, dots_saveable)")
 
 
 # ---------------------------------------------------------------------------
@@ -188,7 +193,8 @@ def _pipeline_loss_local(
 
         tp_axis = AXIS_TP if jax.lax.axis_size(AXIS_TP) > 1 else None
         y = llama.run_layers(local_layers, x_in, pad_mask, cos, sin, cfg,
-                             attn_fn=attn_fn, remat=pcfg.remat, tp_axis=tp_axis)
+                             attn_fn=attn_fn, remat=pcfg.remat, tp_axis=tp_axis,
+                             remat_policy=pcfg.remat_policy)
 
         # Collect the last stage's finished microbatch; everyone else (and
         # warmup ticks) writes to the discard slot.

@@ -22,11 +22,13 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from llama_pipeline_parallel_tpu.models.llama import model as llama_model
 from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
 from llama_pipeline_parallel_tpu.parallel.mesh import AXIS_DP
 from llama_pipeline_parallel_tpu.parallel.pipeline import (
     PipelineConfig,
     make_pipeline_loss_and_grad,
+    stack_stages,
     stage_param_specs,
 )
 
@@ -110,18 +112,48 @@ def state_shardings(mesh: Mesh, tx: optax.GradientTransformation, params_like: P
 # State init / step
 # ---------------------------------------------------------------------------
 
+def init_params_sharded(
+    rng: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    manifest,
+) -> Params:
+    """Initialize params DIRECTLY into their mesh sharding: each device
+    materializes only its stage/tp shard, never the full model.
+
+    This is the analogue of the reference's `LayerSpec` deferred construction
+    (models/llama_ds_mp_wrap.py:214-219, README.md:21-22 — avoiding the
+    65B x world_size host-RAM blowup): under jit with out_shardings, XLA
+    allocates every leaf sharded from the start.
+    """
+
+    def build(rng):
+        return stack_stages(llama_model.init_params(rng, cfg), manifest)
+
+    shapes = jax.eval_shape(build, rng)
+    specs = stage_param_specs(shapes, tp=mesh.shape["tp"] > 1)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    return jax.jit(build, out_shardings=shardings)(rng)
+
+
 def init_train_state(
     params_stacked: Params,
     tx: optax.GradientTransformation,
     mesh: Mesh,
+    donate_params: bool = False,
 ) -> TrainState:
     """Place params and freshly initialized optimizer state onto the mesh with
-    ZeRO-1 shardings."""
+    ZeRO-1 shardings.
+
+    `donate_params=True` consumes the caller's buffers (no copy) — use when
+    the init output is not needed afterwards (a full fp32 param copy is real
+    HBM at 65B scale). Default copies: a bare device_put can alias the
+    caller's arrays when shardings are compatible, and the donated train step
+    would then delete the caller's copies out from under it."""
     shardings = state_shardings(mesh, tx, params_stacked)
-    # jit-identity (no donation) guarantees NEW buffers: a bare device_put can
-    # alias the caller's arrays when shardings are compatible, and the donated
-    # train step would then delete the caller's copies out from under it.
-    params = jax.jit(lambda p: p, out_shardings=shardings.params)(params_stacked)
+    params = jax.jit(lambda p: p, out_shardings=shardings.params,
+                     donate_argnums=(0,) if donate_params else ())(params_stacked)
     opt_state = jax.jit(tx.init, out_shardings=shardings.opt_state)(params)
     step = jax.device_put(jnp.zeros((), jnp.int32), shardings.step)
     return TrainState(step=step, params=params, opt_state=opt_state)

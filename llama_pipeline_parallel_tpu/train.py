@@ -17,6 +17,7 @@ Re-implements the reference's `main()` + `train()` orchestration
 from __future__ import annotations
 
 import os
+import signal
 from typing import Any, Iterator
 
 import jax
@@ -140,7 +141,8 @@ def run_training(cfg: dict) -> dict:
     pcfg = pl.PipelineConfig(
         num_stages=mesh_cfg.pp,
         num_microbatches=cfg.get("gradient_accumulation_steps", 1),
-        remat=cfg.get("activation_checkpointing", True))
+        remat=cfg.get("activation_checkpointing", True),
+        remat_policy=cfg.get("remat_policy", "nothing_saveable"))
 
     dataset, collator = build_dataset_and_collator(cfg, model_cfg)
     micro_batch = cfg.get("per_device_train_batch_size", 1)
@@ -174,8 +176,10 @@ def run_training(cfg: dict) -> dict:
     tx, schedule = make_optimizer(ocfg)
 
     # ---- params: fresh init, warm start, or resume ------------------------
-    params = llama.init_params(jax.random.PRNGKey(seed), model_cfg)
-    stacked_template = pl.stack_stages(params, manifest)
+    # Sharded init: each device materializes only its own stage/tp shard
+    # (the reference's LayerSpec lazy construction, README.md:21-22).
+    stacked_template = ts.init_params_sharded(
+        jax.random.PRNGKey(seed), model_cfg, mesh, manifest)
     mgr = CheckpointManager(output_dir)
 
     if cfg.get("optimizer_offload"):
@@ -184,7 +188,12 @@ def run_training(cfg: dict) -> dict:
 
     resume_step = 0
     resume = mgr.latest_step() if cfg.get("resume", True) else None
-    state = ts.init_train_state(stacked_template, tx, mesh)
+    # Donate the init output into the train state (no second fp32 copy) and
+    # keep only abstract shapes as the structure template from here on.
+    template_struct = jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                                   stacked_template)
+    state = ts.init_train_state(stacked_template, tx, mesh, donate_params=True)
+    stacked_template = template_struct
     if resume is not None:
         p, o, resume_step = mgr.load(resume, state.params, state.opt_state, manifest)
         shard_of = lambda tmpl: jax.tree.map(lambda x: x.sharding, tmpl)
@@ -322,38 +331,77 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
     for _ in range(resume_step):  # dataloader fast-forward (reference :345-351)
         next(it)
 
+    # Preemption-aware save (SURVEY.md §5.3): on SIGTERM/SIGINT — the TPU-VM
+    # maintenance-event notice — finish the current step, checkpoint, exit
+    # cleanly so the next run resumes instead of losing the interval. After
+    # the first signal the default handlers come back, so a second Ctrl+C
+    # force-quits a wedged save.
+    stop_signal: list[int] = []
+
+    def _on_signal(sig, frame):
+        stop_signal.append(sig)
+        for s in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(s, signal.SIG_DFL)
+
+    previous_handlers = {
+        sig: signal.signal(sig, _on_signal)
+        for sig in (signal.SIGTERM, signal.SIGINT)
+    }
+
     losses: list = []  # jax scalars; fetched only at logging boundaries
     final_loss = float("nan")
     last_saved = -1
-    for step in range(resume_step, end_step):
-        if profile_window and not trace_active and step >= profile_window[0] \
-                and step < profile_window[1]:
-            jax.profiler.start_trace(os.path.join(output_dir, "profile"))
-            trace_active = True
-        batch = next(it)
-        loss, scalars_thunk = do_step(batch)
-        if trace_active and (step + 1 >= profile_window[1] or step + 1 == end_step):
-            jax.block_until_ready(loss)
-            jax.profiler.stop_trace()
-            trace_active = False
-            logger.info("profiler trace written to %s/profile", output_dir)
-        losses.append(loss)
-        meter.update(batch["input_ids"].size)
-        if (step + 1) % logging_steps == 0 or step + 1 == end_step:
-            final_loss = float(losses[-1])
-            writer.log(step + 1, {"loss": float(np.mean([float(l) for l in losses])),
-                                  **scalars_thunk(), **meter.read_and_reset()})
-            losses.clear()
-        eval_steps = cfg.get("eval_steps", 0)
-        if do_eval is not None and eval_steps and (step + 1) % eval_steps == 0:
-            writer.log(step + 1, {"eval_loss": do_eval()})
-        if save_steps and (step + 1) % save_steps == 0:
-            do_save(step + 1)
-            last_saved = step + 1
+    try:
+        for step in range(resume_step, end_step):
+            if _should_stop(bool(stop_signal)):
+                logger.warning("preemption signal; checkpointing at step %d and "
+                               "exiting for clean resume", step)
+                do_save(step)
+                last_saved = end_step  # suppress the save_final duplicate
+                break
+            if profile_window and not trace_active and step >= profile_window[0] \
+                    and step < profile_window[1]:
+                jax.profiler.start_trace(os.path.join(output_dir, "profile"))
+                trace_active = True
+            batch = next(it)
+            loss, scalars_thunk = do_step(batch)
+            if trace_active and (step + 1 >= profile_window[1] or step + 1 == end_step):
+                jax.block_until_ready(loss)
+                jax.profiler.stop_trace()
+                trace_active = False
+                logger.info("profiler trace written to %s/profile", output_dir)
+            losses.append(loss)
+            meter.update(batch["input_ids"].size)
+            if (step + 1) % logging_steps == 0 or step + 1 == end_step:
+                final_loss = float(losses[-1])
+                writer.log(step + 1, {"loss": float(np.mean([float(l) for l in losses])),
+                                      **scalars_thunk(), **meter.read_and_reset()})
+                losses.clear()
+            eval_steps = cfg.get("eval_steps", 0)
+            if do_eval is not None and eval_steps and (step + 1) % eval_steps == 0:
+                writer.log(step + 1, {"eval_loss": do_eval()})
+            if save_steps and (step + 1) % save_steps == 0:
+                do_save(step + 1)
+                last_saved = step + 1
+    finally:
+        for sig, handler in previous_handlers.items():
+            signal.signal(sig, handler)
+        writer.close()
     if cfg.get("save_final", True) and last_saved != end_step:
         do_save(end_step)
-    writer.close()
     return final_loss
+
+
+def _should_stop(local_flag: bool) -> bool:
+    """Agree on preemption across hosts: a one-host signal must stop ALL
+    processes at the same step, or the save barrier deadlocks against peers
+    still running the jitted step's collectives."""
+    if jax.process_count() == 1:
+        return local_flag
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(np.asarray(local_flag, np.int32))
+    return bool(np.any(flags))
 
 
 def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
@@ -364,6 +412,12 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
     loss+grad. Grads stream D2H, fresh bf16 params H2D, every step."""
     from jax.sharding import NamedSharding
     from llama_pipeline_parallel_tpu.optim.offload import HostOffloadAdamW
+
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "optimizer_offload currently supports single-process (single-host) "
+            "runs only: the host optimizer needs every master shard addressable "
+            "locally. Use the fused optimizer on pods.")
 
     output_dir = cfg["output_dir"]
     host = HostOffloadAdamW(ocfg)

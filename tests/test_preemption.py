@@ -1,0 +1,47 @@
+"""Preemption-aware checkpointing: SIGTERM mid-run saves and exits cleanly."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+def test_sigterm_saves_checkpoint(tmp_path):
+    out_dir = tmp_path / "out"
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    proc = subprocess.Popen(
+        [sys.executable, "train.py", "--config", "conf/tiny_smoke.yaml",
+         "--platform", "cpu", "max_steps=500", "total_steps=500",
+         "logging_steps=1", f"output_dir={out_dir}"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    # wait until training has made at least one step (first metrics line)
+    deadline = time.time() + 240
+    progressed = False
+    lines = []
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        lines.append(line)
+        if "loss=" in line:
+            progressed = True
+            break
+        if proc.poll() is not None:
+            break
+    assert progressed, "trainer never made a step:\n" + "".join(lines[-20:])
+
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0
+
+    ckpts = [d for d in os.listdir(out_dir) if d.startswith("checkpoint-")]
+    assert ckpts, f"no checkpoint written on SIGTERM; dir: {os.listdir(out_dir)}"
+    assert os.path.exists(out_dir / "latest")
