@@ -58,11 +58,14 @@ def _canonicalize_moments(tree: Any, manifest: StageManifest, to_canonical: bool
 
 
 def _abstract(tree: Any) -> Any:
-    return jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(
+    def leaf(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        return jax.ShapeDtypeStruct(
             np.shape(x), np.asarray(x).dtype if np.isscalar(x) else x.dtype,
-            sharding=getattr(x, "sharding", None)),
-        tree)
+            sharding=getattr(x, "sharding", None))
+
+    return jax.tree.map(leaf, tree)
 
 
 @dataclasses.dataclass

@@ -119,6 +119,54 @@ class DataLoader:
             yield self.collate_fn(rows)
 
 
+class PrefetchIterator:
+    """Background-thread prefetch of the next batch(es) so host-side
+    collation overlaps device compute (the role pin_memory/prefetch_factor
+    play in the reference's DataLoader, trainer_base_ds_mp.py:319-327).
+
+    Wraps any batch iterator; `depth` bounds buffered batches. Exceptions in
+    the producer re-raise on the consumer side.
+    """
+
+    _DONE = object()
+
+    def __init__(self, iterator: Iterator, depth: int = 2):
+        import queue
+        import threading
+
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._err: list[BaseException] = []
+
+        def produce():
+            try:
+                for item in iterator:
+                    self._queue.put(item)
+            except BaseException as e:  # surfaced on the consumer thread
+                self._err.append(e)
+            finally:
+                self._queue.put(self._DONE)
+
+        self._thread = threading.Thread(target=produce, daemon=True)
+        self._thread.start()
+        self._finished = False
+
+    def __iter__(self) -> "PrefetchIterator":
+        return self
+
+    def __next__(self):
+        if self._finished:  # terminal state is sticky — never block again
+            if self._err:
+                raise self._err[0]
+            raise StopIteration
+        item = self._queue.get()
+        if item is self._DONE:
+            self._finished = True
+            if self._err:
+                raise self._err[0]
+            raise StopIteration
+        return item
+
+
 class RepeatingLoader:
     """Infinite wrapper advancing epochs (reference
     `deepspeed.utils.RepeatingLoader`, trainer_base_ds_mp.py:339, plus the

@@ -171,12 +171,26 @@ def run_layers(
                              tp_axis=tp_axis), None
 
     if remat:
-        policy = getattr(jax.checkpoint_policies, remat_policy, None)
-        if policy is None:
-            raise ValueError(f"unknown remat_policy {remat_policy!r}")
-        body = jax.checkpoint(body, policy=policy)
+        body = jax.checkpoint(body, policy=resolve_remat_policy(remat_policy))
     x, _ = jax.lax.scan(body, x, layers)
     return x
+
+
+# Directly-usable jax.checkpoint policies, by config name. Factory attributes
+# (save_only_these_names, ...) need construction arguments and are excluded —
+# name-based selection would fail cryptically at first trace.
+REMAT_POLICIES = (
+    "nothing_saveable",
+    "everything_saveable",
+    "dots_saveable",
+    "dots_with_no_batch_dims_saveable",
+)
+
+
+def resolve_remat_policy(name: str):
+    if name not in REMAT_POLICIES:
+        raise ValueError(f"unknown remat_policy {name!r}; choose one of {REMAT_POLICIES}")
+    return getattr(jax.checkpoint_policies, name)
 
 
 def final_norm(params: Params, x: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:

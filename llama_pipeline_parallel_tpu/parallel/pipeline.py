@@ -76,36 +76,36 @@ class PipelineConfig:
             raise ValueError("num_microbatches must be >= 1")
         if self.num_stages < 1:
             raise ValueError("num_stages must be >= 1")
-        if getattr(jax.checkpoint_policies, self.remat_policy, None) is None:
-            raise ValueError(
-                f"unknown remat_policy {self.remat_policy!r}; see "
-                f"jax.checkpoint_policies (e.g. nothing_saveable, dots_saveable)")
+        llama.resolve_remat_policy(self.remat_policy)  # fail fast on typos
 
 
 # ---------------------------------------------------------------------------
 # Param layout: [n_layers, ...] <-> [num_stages, layers_per_stage, ...]
 # ---------------------------------------------------------------------------
 
+def _reshape_leaf(x, shape: tuple[int, ...]):
+    # works for concrete arrays AND abstract ShapeDtypeStruct templates
+    if isinstance(x, jax.ShapeDtypeStruct):
+        return jax.ShapeDtypeStruct(shape, x.dtype)
+    return x.reshape(shape)
+
+
 def stack_stages(params: Params, manifest: StageManifest) -> Params:
     """Reshape stacked layer leaves to expose the stage axis for pp sharding."""
     s, k = manifest.num_stages, manifest.layers_per_stage
 
-    def reshape(x):
-        return x.reshape((s, k) + x.shape[1:])
-
     out = dict(params)
-    out["layers"] = jax.tree.map(reshape, params["layers"])
+    out["layers"] = jax.tree.map(
+        lambda x: _reshape_leaf(x, (s, k) + tuple(x.shape[1:])), params["layers"])
     return out
 
 
 def unstack_stages(params: Params, manifest: StageManifest) -> Params:
     n = manifest.num_layers
 
-    def reshape(x):
-        return x.reshape((n,) + x.shape[2:])
-
     out = dict(params)
-    out["layers"] = jax.tree.map(reshape, params["layers"])
+    out["layers"] = jax.tree.map(
+        lambda x: _reshape_leaf(x, (n,) + tuple(x.shape[2:])), params["layers"])
     return out
 
 

@@ -55,8 +55,14 @@ def ulysses_attention(
     if h % n:
         raise ValueError(f"num heads {h} must be divisible by sp={n}")
     if h_kv % n:
-        k = repeat_kv(k, h // h_kv)
-        v = repeat_kv(v, h // h_kv)
+        # minimal GQA expansion: smallest repeat making kv heads divide sp
+        # (full expansion would double the all-to-all traffic for nothing —
+        # the inner attention re-expands groups itself)
+        group = h // h_kv
+        r = next(r for r in range(1, group + 1)
+                 if group % r == 0 and (h_kv * r) % n == 0)
+        k = repeat_kv(k, r)
+        v = repeat_kv(v, r)
 
     def scatter_heads(x):
         # [b, s_local, h', hd] -> [b, s_full, h'/n, hd]

@@ -27,7 +27,11 @@ import numpy as np
 from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
 from llama_pipeline_parallel_tpu.data.collator import CausalLMCollator, PretokenizedCollator
 from llama_pipeline_parallel_tpu.data.datasets import SyntheticDataset
-from llama_pipeline_parallel_tpu.data.loader import DataLoader, RepeatingLoader
+from llama_pipeline_parallel_tpu.data.loader import (
+    DataLoader,
+    PrefetchIterator,
+    RepeatingLoader,
+)
 from llama_pipeline_parallel_tpu.models.llama import model as llama
 from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
 from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
@@ -330,6 +334,7 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
     it: Iterator = iter(RepeatingLoader(loader))
     for _ in range(resume_step):  # dataloader fast-forward (reference :345-351)
         next(it)
+    it = PrefetchIterator(it, depth=cfg.get("prefetch_depth", 2))
 
     # Preemption-aware save (SURVEY.md §5.3): on SIGTERM/SIGINT — the TPU-VM
     # maintenance-event notice — finish the current step, checkpoint, exit
@@ -351,9 +356,16 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
     losses: list = []  # jax scalars; fetched only at logging boundaries
     final_loss = float("nan")
     last_saved = -1
+    # Pods agree on preemption via a host collective; running it every step
+    # would sync the hot loop, so check on a fixed cadence — the SAME steps on
+    # every host (the decision must never depend on a host-local flag, or the
+    # allgather call counts diverge and the pod hangs).
+    check_every = max(int(cfg.get("preempt_check_every", 10)), 1)
+
     try:
         for step in range(resume_step, end_step):
-            if _should_stop(bool(stop_signal)):
+            check_now = jax.process_count() == 1 or step % check_every == 0
+            if check_now and _should_stop(bool(stop_signal)):
                 logger.warning("preemption signal; checkpointing at step %d and "
                                "exiting for clean resume", step)
                 do_save(step)
@@ -422,6 +434,11 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
     output_dir = cfg["output_dir"]
     host = HostOffloadAdamW(ocfg)
     host.init(stacked_template)
+    # fp32 masters now live on the host; drop the device fp32 init copy and
+    # keep only abstract shapes as the structure template (HBM holds just the
+    # bf16 working copy, the point of the offload path)
+    stacked_template = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), stacked_template)
 
     resume_step = 0
     resume = mgr.latest_step() if cfg.get("resume", True) else None

@@ -107,6 +107,22 @@ def test_dataloader_global_layout_and_repeat():
     assert seen[3]["input_ids"].shape == (4, 8)
 
 
+def test_prefetch_iterator():
+    from llama_pipeline_parallel_tpu.data.loader import PrefetchIterator
+
+    items = list(PrefetchIterator(iter(range(7)), depth=2))
+    assert items == list(range(7))
+
+    def boom():
+        yield 1
+        raise RuntimeError("producer failed")
+
+    it = PrefetchIterator(boom())
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="producer failed"):
+        next(it)
+
+
 def test_synthetic_dataset_deterministic():
     ds = SyntheticDataset(vocab_size=100, seq_length=16, pseudo_dataset_len=4,
                           pad_fraction=0.25)

@@ -20,17 +20,28 @@ def test_sigterm_saves_checkpoint(tmp_path):
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
 
-    # wait until training has made at least one step (first metrics line)
+    # wait until training has made at least one step (first metrics line);
+    # read via a thread so a silently-wedged trainer can't block readline
+    # past the deadline
+    import queue
+    import threading
+
+    line_q: "queue.Queue[str]" = queue.Queue()
+    threading.Thread(target=lambda: [line_q.put(l) for l in proc.stdout],
+                     daemon=True).start()
     deadline = time.time() + 240
     progressed = False
     lines = []
     while time.time() < deadline:
-        line = proc.stdout.readline()
+        try:
+            line = line_q.get(timeout=5)
+        except queue.Empty:
+            if proc.poll() is not None:
+                break
+            continue
         lines.append(line)
         if "loss=" in line:
             progressed = True
-            break
-        if proc.poll() is not None:
             break
     assert progressed, "trainer never made a step:\n" + "".join(lines[-20:])
 
