@@ -134,6 +134,10 @@ class PrefetchIterator:
         import queue
         import threading
 
+        if depth < 1:
+            raise ValueError(
+                f"prefetch depth must be >= 1, got {depth} (Queue(0) would be "
+                f"UNBOUNDED buffering of an infinite loader)")
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._err: list[BaseException] = []
 

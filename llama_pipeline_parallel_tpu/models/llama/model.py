@@ -183,7 +183,9 @@ REMAT_POLICIES = (
     "nothing_saveable",
     "everything_saveable",
     "dots_saveable",
+    "checkpoint_dots",  # alias of dots_saveable
     "dots_with_no_batch_dims_saveable",
+    "checkpoint_dots_with_no_batch_dims",  # alias
 )
 
 

@@ -111,18 +111,59 @@ def unstack_stages(params: Params, manifest: StageManifest) -> Params:
 
 def stage_param_specs(params: Params, tp: bool = False) -> Params:
     """PartitionSpec tree for stage-stacked params: layer leaves sharded over
-    pp on the stage axis, embed/norm/head replicated.
+    pp on the stage axis, embed/final-norm replicated.
 
     With `tp`, matmul weights additionally shard Megatron-style over the tp
     axis: qkv/gate/up column-parallel (output dim), wo/down row-parallel
-    (input dim); norms stay replicated over tp."""
+    (input dim); norms stay replicated over tp. The lm_head is
+    vocab-parallel (output vocab dim over tp) and the loss computes a
+    vocab-parallel cross-entropy — full [.., vocab] logits never exist on
+    any one device."""
     specs = jax.tree.map(lambda _: P(), params)
     specs["layers"] = jax.tree.map(lambda _: P(AXIS_PP), params["layers"])
     if tp:
         col, row = P(AXIS_PP, None, None, AXIS_TP), P(AXIS_PP, None, AXIS_TP, None)
         specs["layers"]["attn"] = {"wq": col, "wk": col, "wv": col, "wo": row}
         specs["layers"]["mlp"] = {"gate": col, "up": col, "down": row}
+        specs["lm_head"] = P(None, AXIS_TP)
     return specs
+
+
+def _vocab_parallel_token_loss(params: Params, h: jnp.ndarray, labels: jnp.ndarray,
+                               cfg: LlamaConfig) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Shifted CE with the lm_head vocab-sharded over tp.
+
+    Each rank computes logits only for its vocab shard; the log-sum-exp and
+    the target logit are combined with `tp_reduce` (psum forward, identity
+    backward — the correct VJP under the pipeline's unchecked shard_map; a
+    bare psum inside the differentiated region would double-count, see
+    _loss_and_grad_local). The row max used for stability goes through
+    `tp_max` (zero-gradient pmax), so the softmax gradient stays exact.
+    """
+    from llama_pipeline_parallel_tpu.parallel.tp import tp_copy, tp_max, tp_reduce
+
+    head_local = params["lm_head"].astype(cfg.dtype)  # [d, V/n] local shard
+    # column-parallel matmul: replicated h fans into vocab shards, so dh must
+    # be psum'd across tp in backward (the Megatron f operator)
+    logits = (tp_copy(h, AXIS_TP) @ head_local).astype(jnp.float32)  # [b, s, V/n]
+    shift_logits = logits[:, :-1, :]
+    shift_labels = labels[:, 1:]
+    valid = shift_labels != llama.IGNORE_INDEX
+
+    v_local = shift_logits.shape[-1]
+    offset = jax.lax.axis_index(AXIS_TP) * v_local
+
+    m = tp_max(jax.lax.stop_gradient(shift_logits.max(axis=-1)), AXIS_TP)  # [b, s-1]
+    z = tp_reduce(jnp.exp(shift_logits - m[..., None]).sum(axis=-1), AXIS_TP)
+
+    local_idx = jnp.where(valid, shift_labels, 0) - offset
+    owned = (local_idx >= 0) & (local_idx < v_local) & valid
+    safe_idx = jnp.clip(local_idx, 0, v_local - 1)
+    picked = jnp.take_along_axis(shift_logits, safe_idx[..., None], axis=-1)[..., 0]
+    target = tp_reduce(jnp.where(owned, picked, 0.0), AXIS_TP)
+
+    token_loss = (m + jnp.log(z)) - target
+    return jnp.where(valid, token_loss, 0.0).sum(), valid.sum()
 
 
 # ---------------------------------------------------------------------------
@@ -215,10 +256,16 @@ def _pipeline_loss_local(
 
     # Loss over collected last-stage hiddens, one microbatch at a time so the
     # [mb, L, vocab] logits buffer never exceeds a single microbatch.
+    tp_size = jax.lax.axis_size(AXIS_TP)
+
     def loss_tick(acc, inp):
         h, labels = inp
-        logits = llama.lm_head(params, llama.final_norm(params, h, cfg), cfg)
-        mb_sum, mb_count = llama.token_loss_sum_and_count(logits, labels)
+        h = llama.final_norm(params, h, cfg)
+        if tp_size > 1:
+            mb_sum, mb_count = _vocab_parallel_token_loss(params, h, labels, cfg)
+        else:
+            logits = llama.lm_head(params, h, cfg)
+            mb_sum, mb_count = llama.token_loss_sum_and_count(logits, labels)
         loss_sum, count = acc
         return (loss_sum + mb_sum, count + mb_count), None
 
@@ -319,6 +366,9 @@ def make_pipeline_loss_and_grad(
                 f"{cfg.num_attention_heads} and kv_heads={cfg.kv_heads}")
         if cfg.intermediate_size % tp:
             raise ValueError(f"tp={tp} must divide intermediate_size={cfg.intermediate_size}")
+        if cfg.vocab_size % tp:
+            raise ValueError(f"tp={tp} must divide vocab_size={cfg.vocab_size} "
+                             f"(vocab-parallel lm_head)")
     param_specs = stage_param_specs(params_like, tp=tp > 1)
     batch_specs = {
         "input_ids": P(AXIS_DP), "attention_mask": P(AXIS_DP),

@@ -56,3 +56,22 @@ def _reduce_bwd(axis_name, _, g):
 
 
 tp_reduce.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_max(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Cross-rank max with ZERO gradient — for numerical-stability shifts
+    (the subtracted max cancels mathematically, and `lax.pmax` has no
+    differentiation rule at all, even under stop_gradient)."""
+    return jax.lax.pmax(x, axis_name)
+
+
+def _max_fwd(x, axis_name):
+    return jax.lax.pmax(x, axis_name), jnp.shape(x)
+
+
+def _max_bwd(axis_name, shape, g):
+    return (jnp.zeros(shape, g.dtype),)
+
+
+tp_max.defvjp(_max_fwd, _max_bwd)
