@@ -34,9 +34,12 @@ Design (and why it is not a translation of DeepSpeed):
   axis the reference shards over, conf yaml zero_optimization block).
 
 The compute order within a tick is identical on every device (SPMD), so the
-lm-head matmul runs on all stages; it is hoisted out of the tick loop and
-applied once per microbatch afterwards, which keeps the per-tick critical path
-to exactly one stage's decoder layers.
+final-norm/lm-head/loss of a finished microbatch runs on every stage each
+tick (masked to the last stage's contribution). That costs one lm-head
+matmul per tick — a few percent of a stage's decoder layers at real model
+sizes — and in exchange nothing is ever collected into an M-sized buffer:
+per-flush activation memory is the stage-boundary carries alone, and
+`accum_chunks` bounds even those.
 """
 
 from __future__ import annotations
@@ -70,12 +73,23 @@ class PipelineConfig:
     num_microbatches: int
     remat: bool = True
     remat_policy: str = "nothing_saveable"
+    # Split the microbatches into this many sequential pipeline flushes within
+    # ONE jitted step. Activation memory scales with num_microbatches/chunks
+    # (each flush's stage-boundary activations are freed before the next),
+    # at the price of one extra (num_stages-1)-tick bubble per chunk. The
+    # knob that makes grad-accum 256 runs fit: e.g. chunks=8 at M=256 stores
+    # 32 microbatches of activations instead of 256 for a ~15% bubble.
+    accum_chunks: int = 1
 
     def __post_init__(self) -> None:
         if self.num_microbatches < 1:
             raise ValueError("num_microbatches must be >= 1")
         if self.num_stages < 1:
             raise ValueError("num_stages must be >= 1")
+        if self.accum_chunks < 1 or self.num_microbatches % self.accum_chunks:
+            raise ValueError(
+                f"accum_chunks={self.accum_chunks} must divide "
+                f"num_microbatches={self.num_microbatches}")
         llama.resolve_remat_policy(self.remat_policy)  # fail fast on typos
 
 
@@ -204,13 +218,23 @@ def _pipeline_loss_local(
 
     num_ticks = m_total + s_total - 1
     hidden_shape = (mb, seqlen, cfg.hidden_size)
-
-    # Output collection: slot m_total is the discard slot for warmup garbage.
-    outs_init = jnp.zeros((m_total + 1,) + hidden_shape, cfg.dtype)
     x_init = jnp.zeros(hidden_shape, cfg.dtype)
+    tp_size = jax.lax.axis_size(AXIS_TP)
+
+    def mb_loss(h, labels):
+        """Per-microbatch loss from last-stage hiddens. Checkpointed in the
+        tick so the [mb, L, vocab] logits are recomputed in backward from the
+        (already stored) hiddens — never M copies of logits."""
+        h = llama.final_norm(params, h, cfg)
+        if tp_size > 1:
+            return _vocab_parallel_token_loss(params, h, labels, cfg)
+        logits = llama.lm_head(params, h, cfg)
+        return llama.token_loss_sum_and_count(logits, labels)
+
+    mb_loss = jax.checkpoint(mb_loss)
 
     def tick(carry, t):
-        x_prev, outs = carry
+        x_prev, loss_sum, count = carry
         # Microbatch indices for this tick: stage 0 consumes microbatch t;
         # this stage computes microbatch (t - stage).
         in_idx = jnp.clip(t, 0, m_total - 1)
@@ -232,16 +256,19 @@ def _pipeline_loss_local(
             pad_mask = None
         cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta, dtype=cfg.dtype)
 
-        tp_axis = AXIS_TP if jax.lax.axis_size(AXIS_TP) > 1 else None
+        tp_axis = AXIS_TP if tp_size > 1 else None
         y = llama.run_layers(local_layers, x_in, pad_mask, cos, sin, cfg,
                              attn_fn=attn_fn, remat=pcfg.remat, tp_axis=tp_axis,
                              remat_policy=pcfg.remat_policy)
 
-        # Collect the last stage's finished microbatch; everyone else (and
-        # warmup ticks) writes to the discard slot.
-        out_idx = jnp.where(is_last & (my_idx >= 0), jnp.clip(my_idx, 0, m_total - 1),
-                            m_total)
-        outs = jax.lax.dynamic_update_index_in_dim(outs, y, out_idx, axis=0)
+        # The last stage's finished microbatch contributes its loss in-tick
+        # (nothing is collected into an M-sized buffer; lm-head cost per tick
+        # is a few percent of a stage's decoder layers at real sizes).
+        labels = jax.lax.dynamic_index_in_dim(labels_m, mb_idx, keepdims=False)
+        mb_sum, mb_count = mb_loss(y, labels)
+        take = is_last & (my_idx >= 0)
+        loss_sum = loss_sum + jnp.where(take, mb_sum, 0.0)
+        count = count + jnp.where(take, mb_count, 0)
 
         # Hand off to the next stage over the ICI ring (NCCL-P2P analogue).
         if s_total > 1:
@@ -249,28 +276,10 @@ def _pipeline_loss_local(
             x_next = jax.lax.ppermute(y, AXIS_PP, perm)
         else:
             x_next = y
-        return (x_next, outs), None
+        return (x_next, loss_sum, count), None
 
-    (_, outs), _ = jax.lax.scan(tick, (x_init, outs_init), jnp.arange(num_ticks))
-    outs = outs[:m_total]
-
-    # Loss over collected last-stage hiddens, one microbatch at a time so the
-    # [mb, L, vocab] logits buffer never exceeds a single microbatch.
-    tp_size = jax.lax.axis_size(AXIS_TP)
-
-    def loss_tick(acc, inp):
-        h, labels = inp
-        h = llama.final_norm(params, h, cfg)
-        if tp_size > 1:
-            mb_sum, mb_count = _vocab_parallel_token_loss(params, h, labels, cfg)
-        else:
-            logits = llama.lm_head(params, h, cfg)
-            mb_sum, mb_count = llama.token_loss_sum_and_count(logits, labels)
-        loss_sum, count = acc
-        return (loss_sum + mb_sum, count + mb_count), None
-
-    (loss_sum, count), _ = jax.lax.scan(
-        loss_tick, (jnp.float32(0.0), jnp.int32(0)), (outs, labels_m))
+    (_, loss_sum, count), _ = jax.lax.scan(
+        tick, (x_init, jnp.float32(0.0), jnp.int32(0)), jnp.arange(num_ticks))
 
     # Only the last stage's numbers are real.
     loss_sum = jnp.where(is_last, loss_sum, 0.0)
@@ -292,11 +301,32 @@ def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn):
     global_count = jnp.maximum(
         jax.lax.psum(local_count, AXIS_DP), 1).astype(jnp.float32)
 
-    def scalar_loss(p):
-        loss_sum, _ = _pipeline_loss_local(p, batch, cfg, pcfg, attn_fn)
+    chunks = pcfg.accum_chunks
+    chunk_pcfg = dataclasses.replace(
+        pcfg, num_microbatches=pcfg.num_microbatches // chunks, accum_chunks=1)
+
+    def chunk_loss(p, chunk_batch):
+        loss_sum, _ = _pipeline_loss_local(p, chunk_batch, cfg, chunk_pcfg, attn_fn)
         return loss_sum / global_count  # nonzero on the last stage only
 
-    local_loss, grads = jax.value_and_grad(scalar_loss)(params)
+    if chunks == 1:
+        local_loss, grads = jax.value_and_grad(chunk_loss)(params, batch)
+    else:
+        # Sequential pipeline flushes: each chunk's fwd+bwd completes (and its
+        # activations are freed) before the next starts; grads accumulate in
+        # fp32. Normalizing every chunk by the same global token count makes
+        # the sum exactly the full-batch gradient.
+        chunked = jax.tree.map(
+            lambda x: x.reshape((chunks, x.shape[0] // chunks) + x.shape[1:]), batch)
+
+        def accum(carry, chunk_batch):
+            acc_loss, acc_grads = carry
+            l, g = jax.value_and_grad(chunk_loss)(params, chunk_batch)
+            return (acc_loss + l, jax.tree.map(jnp.add, acc_grads, g)), None
+
+        zero_grads = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), params)
+        (local_loss, grads), _ = jax.lax.scan(
+            accum, (jnp.float32(0.0), zero_grads), chunked)
     loss = jax.lax.psum(local_loss, (AXIS_PP, AXIS_DP))
 
     # Stage-sharded leaves: reduce across dp replicas only. Replicated leaves

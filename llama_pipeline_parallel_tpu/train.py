@@ -146,7 +146,8 @@ def run_training(cfg: dict) -> dict:
         num_stages=mesh_cfg.pp,
         num_microbatches=cfg.get("gradient_accumulation_steps", 1),
         remat=cfg.get("activation_checkpointing", True),
-        remat_policy=cfg.get("remat_policy", "nothing_saveable"))
+        remat_policy=cfg.get("remat_policy", "nothing_saveable"),
+        accum_chunks=cfg.get("gradient_accumulation_chunks", 1))
 
     dataset, collator = build_dataset_and_collator(cfg, model_cfg)
     micro_batch = cfg.get("per_device_train_batch_size", 1)

@@ -54,11 +54,12 @@ def reference_loss_and_grad(params, batch, cfg):
     return jax.value_and_grad(loss)(params)
 
 
-def run_pipeline(params, batch, cfg, pp, dp, microbatches, remat=True):
+def run_pipeline(params, batch, cfg, pp, dp, microbatches, remat=True, chunks=1):
     mesh = make_mesh(MeshConfig(pp=pp, dp=dp))
     manifest = StageManifest.for_config(cfg, pp)
     stacked = pl.stack_stages(params, manifest)
-    pcfg = pl.PipelineConfig(num_stages=pp, num_microbatches=microbatches, remat=remat)
+    pcfg = pl.PipelineConfig(num_stages=pp, num_microbatches=microbatches,
+                             remat=remat, accum_chunks=chunks)
     fn = jax.jit(pl.make_pipeline_loss_and_grad(mesh, cfg, pcfg, stacked))
     loss, grads = fn(stacked, batch)
     return loss, pl.unstack_stages(grads, manifest)
@@ -85,6 +86,22 @@ def test_pp_matches_reference(cfg, params, devices, pp, dp, microbatches):
     loss, grads = run_pipeline(params, batch, cfg, pp=pp, dp=dp, microbatches=microbatches)
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
     assert_tree_close(grads, ref_grads)
+
+
+@pytest.mark.parametrize("chunks", [2, 4])
+def test_chunked_accumulation_matches(cfg, params, devices, chunks):
+    """accum_chunks splits the flush without changing loss or gradients."""
+    batch = make_batch(cfg, batch_size=8)
+    ref_loss, ref_grads = reference_loss_and_grad(params, batch, cfg)
+    loss, grads = run_pipeline(params, batch, cfg, pp=4, dp=1, microbatches=4,
+                               chunks=chunks)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    assert_tree_close(grads, ref_grads)
+
+
+def test_bad_chunks():
+    with pytest.raises(ValueError, match="accum_chunks"):
+        pl.PipelineConfig(num_stages=2, num_microbatches=4, accum_chunks=3)
 
 
 def test_remat_off_matches(cfg, params, devices):
