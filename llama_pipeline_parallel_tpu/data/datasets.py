@@ -96,6 +96,66 @@ class ConcatDataset:
 
 
 @dataclasses.dataclass
+class MixtureDataset:
+    """Deterministic weighted interleaving of datasets.
+
+    Covers the reference's composite dataset wrappers
+    (WikiPathDatasetV5WFlan / FlanCollectionGroupDataset, reference
+    data/flan.py:65-146, which pair wiki examples with FLAN data) as a
+    general mechanism: items are drawn from each source in proportion to
+    `weights`, in a fixed interleave so every epoch sees the same order
+    (shuffling happens in the sampler, by index).
+    """
+
+    datasets: Sequence[Any]
+    weights: Sequence[float] | None = None
+
+    def __post_init__(self) -> None:
+        if not self.datasets:
+            raise ValueError("MixtureDataset needs at least one dataset")
+        w = self.weights or [1.0] * len(self.datasets)
+        if len(w) != len(self.datasets) or min(w) <= 0:
+            raise ValueError(f"bad weights {w} for {len(self.datasets)} datasets")
+        # one "block" of the interleave pattern, proportional to weights
+        # (small-integer ratio so short datasets still yield >= 1 block)
+        from fractions import Fraction
+        from math import lcm
+
+        total = sum(w)
+        fracs = [Fraction(x / total).limit_denominator(1024) for x in w]
+        denom = lcm(*(f.denominator for f in fracs))
+        counts = [int(f * denom) for f in fracs]
+        if min(counts) < 1:
+            raise ValueError(
+                f"weight ratio {w} too extreme to interleave exactly "
+                f"(a source rounds to zero draws per block); cap ratios ~1000:1")
+        pattern: list[int] = []
+        idx = [0.0] * len(counts)
+        for _ in range(sum(counts)):
+            j = int(np.argmax([c - i for c, i in zip(counts, idx)]))
+            pattern.append(j)
+            idx[j] += 1
+        self._pattern = pattern
+        # epoch length: bounded by the source that exhausts first
+        per_block = [pattern.count(j) for j in range(len(self.datasets))]
+        blocks = min(len(d) // c for d, c in zip(self.datasets, per_block))
+        self._per_block = per_block
+        self._blocks = blocks
+
+    def __len__(self) -> int:
+        return self._blocks * len(self._pattern)
+
+    def __getitem__(self, idx: int):
+        if not 0 <= idx < len(self):
+            raise IndexError(idx)
+        block, offset = divmod(idx, len(self._pattern))
+        src = self._pattern[offset]
+        # how many times src appeared earlier in this block
+        nth = self._pattern[:offset].count(src)
+        return self.datasets[src][block * self._per_block[src] + nth]
+
+
+@dataclasses.dataclass
 class SyntheticDataset:
     """Deterministic random-token dataset (TestDataset equivalent,
     reference data/test.py:4-22) that already emits the full batch protocol."""

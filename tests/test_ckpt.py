@@ -112,6 +112,28 @@ def test_latest_tag_and_resume_detection(tmp_path, cfg, devices):
     assert find_resume_checkpoint(str(tmp_path))[0] == 5
 
 
+def test_hf_export_round_trip(tmp_path, cfg, devices):
+    """native ckpt -> HF (tools/export_hf) -> logits parity with our forward."""
+    torch = pytest.importorskip("torch")
+    state, manifest, tx = _trained_state(cfg, pp=2, dp=1, steps=1)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, state.params, manifest, cfg)
+
+    from tools.export_hf import export
+    out = str(tmp_path / "hf")
+    export(str(tmp_path), out)
+
+    from transformers import LlamaForCausalLM
+    hf_model = LlamaForCausalLM.from_pretrained(out).eval()
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, size=(1, 12))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(llama.forward(
+        pl.unstack_stages(jax.device_get(state.params), manifest),
+        jnp.asarray(ids), cfg=cfg))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
 def test_hf_converter_end_to_end(tmp_path, devices):
     """convert2ckpt.py equivalent: HF model -> native ckpt -> logits parity."""
     torch = pytest.importorskip("torch")

@@ -14,6 +14,7 @@ from llama_pipeline_parallel_tpu.data.collator import (
 from llama_pipeline_parallel_tpu.data.datasets import (
     ConcatDataset,
     JsonSeq2SeqDataset,
+    MixtureDataset,
     SyntheticDataset,
 )
 from llama_pipeline_parallel_tpu.data.loader import DataLoader, RepeatingLoader, ShardedSampler
@@ -105,6 +106,24 @@ def test_dataloader_global_layout_and_repeat():
     rl = iter(RepeatingLoader(dl))
     seen = [next(rl) for _ in range(7)]  # crosses two epoch boundaries
     assert seen[3]["input_ids"].shape == (4, 8)
+
+
+def test_mixture_dataset():
+    a = [{"src": "a", "i": i} for i in range(30)]
+    b = [{"src": "b", "i": i} for i in range(10)]
+    mix = MixtureDataset([a, b], weights=[3.0, 1.0])
+    items = [mix[i] for i in range(len(mix))]
+    counts = {"a": sum(x["src"] == "a" for x in items),
+              "b": sum(x["src"] == "b" for x in items)}
+    assert counts["a"] == 3 * counts["b"]
+    # every item from each source appears at most once and in order
+    a_items = [x["i"] for x in items if x["src"] == "a"]
+    assert a_items == sorted(set(a_items))
+    assert mix[0] == mix[0]  # deterministic
+    with pytest.raises(IndexError):
+        mix[len(mix)]
+    with pytest.raises(ValueError):
+        MixtureDataset([a, b], weights=[1.0])
 
 
 def test_prefetch_iterator():

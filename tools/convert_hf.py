@@ -14,7 +14,11 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
+
+# invocable as a script from anywhere: the package lives next to tools/
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def convert(model_name_or_path: str, output_dir: str, expand_vocab: bool = True) -> None:
@@ -54,6 +58,12 @@ def convert(model_name_or_path: str, output_dir: str, expand_vocab: bool = True)
 
 
 def main(argv: list[str] | None = None) -> None:
+    # standalone CLI: conversion is host-side work — never wait on accelerators
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model_name_or_path", required=True)
     p.add_argument("--output_dir", required=True)

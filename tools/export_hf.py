@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Native checkpoint -> HF LlamaForCausalLM exporter (the converter's inverse).
+
+The reference ships only HF -> DeepSpeed (convert2ckpt.py); going back
+required hand-written scripts. Here trained weights round-trip into the HF
+ecosystem directly:
+
+    python tools/export_hf.py --checkpoint_dir /ckpts/run1 --output_dir /hf/out
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# invocable as a script from anywhere: the package lives next to tools/
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def export(checkpoint_dir: str, output_dir: str, step: int | None = None) -> None:
+    import jax
+    import torch
+    from transformers import LlamaConfig as HFLlamaConfig
+    from transformers import LlamaForCausalLM
+
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
+    from llama_pipeline_parallel_tpu.models.llama import model as llama
+    from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+    from llama_pipeline_parallel_tpu.models.llama.hf import hf_state_dict_from_params
+    from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+    from llama_pipeline_parallel_tpu.parallel.pipeline import stack_stages, unstack_stages
+
+    mgr = CheckpointManager(checkpoint_dir)
+    if step is None:
+        step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {checkpoint_dir}")
+    meta = mgr.load_meta(step)
+    mc = dict(meta["model_config"])
+    mc.pop("dtype", None), mc.pop("param_dtype", None)
+    cfg = LlamaConfig(**mc)
+    manifest = StageManifest(**meta["manifest"])
+
+    template = stack_stages(llama.init_params(jax.random.PRNGKey(0), cfg), manifest)
+    params = unstack_stages(mgr.load_params(step, template, manifest), manifest)
+    sd = {k: torch.from_numpy(v) for k, v in
+          hf_state_dict_from_params(params, cfg).items()}
+
+    hf_cfg = HFLlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=cfg.kv_heads,
+        max_position_embeddings=cfg.max_position_embeddings,
+        rms_norm_eps=cfg.rms_norm_eps, rope_theta=cfg.rope_theta,
+        tie_word_embeddings=cfg.tie_word_embeddings)
+    model = LlamaForCausalLM(hf_cfg)
+    missing, unexpected = model.load_state_dict(sd, strict=False)
+    if [m for m in missing if "rotary" not in m] or unexpected:
+        raise RuntimeError(f"state mismatch: missing={missing} unexpected={unexpected}")
+    model.save_pretrained(output_dir, safe_serialization=True)
+    # carry tokenizer files along (convert_hf.py places them next to the
+    # native checkpoint precisely so the round trip is self-contained)
+    import shutil
+
+    for name in os.listdir(checkpoint_dir):
+        if "token" in name or name in ("special_tokens_map.json", "vocab.json",
+                                       "merges.txt", "spiece.model"):
+            shutil.copy2(os.path.join(checkpoint_dir, name),
+                         os.path.join(output_dir, name))
+    print(f"exported checkpoint-{step} to {output_dir}")
+
+
+def main(argv: list[str] | None = None) -> None:
+    # standalone CLI: conversion is host-side work — never wait on accelerators
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--checkpoint_dir", required=True)
+    p.add_argument("--output_dir", required=True)
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step (default: latest)")
+    args = p.parse_args(argv)
+    export(args.checkpoint_dir, args.output_dir, args.step)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
