@@ -13,10 +13,37 @@ is measured MFU / 0.45 — the 45%-MFU north-star from BASELINE.json.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 
+def _watchdog(seconds: int):
+    """The TPU tunnel can wedge indefinitely (even trivial ops hang); emit a
+    diagnostic JSON line instead of hanging the harness forever. Returns the
+    timer; the caller cancels it the moment timing completes, BEFORE printing,
+    so exactly one JSON line is ever emitted.
+
+    A timer THREAD, not SIGALRM: the wedge sits in a blocking C call on the
+    main thread, so a Python signal handler would never run — a thread still
+    gets scheduled whenever the call releases the GIL."""
+    import threading
+
+    def fire():
+        print(json.dumps({
+            "metric": "tokens_per_sec_per_chip", "value": 0.0,
+            "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "error": f"bench watchdog fired after {seconds}s (TPU unreachable?)",
+        }), flush=True)
+        os._exit(2)
+
+    timer = threading.Timer(seconds, fire)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
 def main() -> None:
+    watchdog = _watchdog(int(os.environ.get("BENCH_TIMEOUT_S", "900")))
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -72,6 +99,7 @@ def main() -> None:
     tps = tokens_per_step * n_steps / dt
     peak = detect_chip_peak_flops() or 197e12
     mfu = train_flops_per_token(cfg, seq) * tps / peak
+    watchdog.cancel()
     print(json.dumps({
         "metric": "tokens_per_sec_per_chip",
         "value": round(tps, 1),
