@@ -6,3 +6,18 @@ from llama_pipeline_parallel_tpu.parallel.mesh import (  # noqa: F401
     MeshConfig,
     make_mesh,
 )
+from llama_pipeline_parallel_tpu.parallel.pipeline import (  # noqa: F401
+    PipelineConfig,
+    make_pipeline_eval_fn,
+    make_pipeline_loss_and_grad,
+    stack_stages,
+    unstack_stages,
+)
+from llama_pipeline_parallel_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+from llama_pipeline_parallel_tpu.parallel.train_step import (  # noqa: F401
+    TrainState,
+    init_params_sharded,
+    init_train_state,
+    make_train_step,
+)
+from llama_pipeline_parallel_tpu.parallel.ulysses import ulysses_attention  # noqa: F401

@@ -1,0 +1,37 @@
+"""Single-process paths of the multi-host helpers."""
+
+import numpy as np
+
+from llama_pipeline_parallel_tpu.parallel import distributed as dist
+from llama_pipeline_parallel_tpu.parallel.distributed import (
+    barrier,
+    form_global_batch,
+    host_dp_shard,
+    initialize_distributed,
+)
+from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
+
+
+def test_initialize_and_barrier_noops_single_process(devices, monkeypatch):
+    for env in dist._COORDINATOR_ENVS:
+        monkeypatch.delenv(env, raising=False)
+    monkeypatch.setattr(dist, "_initialized", False)
+    initialize_distributed()  # no coordinator configured -> no-op
+    initialize_distributed()  # idempotent
+    barrier("test")  # single-process -> immediate
+
+
+def test_host_dp_shard_single_process(devices):
+    mesh = make_mesh(MeshConfig(pp=2, dp=4))
+    assert host_dp_shard(mesh) == (0, 4)
+
+
+def test_form_global_batch_places_dp_sharded(devices):
+    mesh = make_mesh(MeshConfig(dp=4, pp=2))
+    batch = {"input_ids": np.arange(32).reshape(8, 4).astype(np.int32)}
+    out = form_global_batch(mesh, batch)
+    arr = out["input_ids"]
+    assert arr.shape == (8, 4)
+    spec = arr.sharding.spec
+    assert tuple(spec)[0] == "dp"
+    np.testing.assert_array_equal(np.asarray(arr), batch["input_ids"])
