@@ -112,6 +112,19 @@ def test_remat_off_matches(cfg, params, devices):
     assert_tree_close(g1, g2)
 
 
+def test_pp8_headline_topology(devices):
+    """The 65B config-of-record topology (PP=8, chunked accumulation) at tiny
+    scale on the full 8-device mesh — every stage boundary exercised."""
+    cfg8 = LlamaConfig.tiny(num_hidden_layers=8)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg8)
+    batch = make_batch(cfg8, batch_size=8)
+    ref_loss, ref_grads = reference_loss_and_grad(params, batch, cfg8)
+    loss, grads = run_pipeline(params, batch, cfg8, pp=8, dp=1, microbatches=4,
+                               chunks=2)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    assert_tree_close(grads, ref_grads)
+
+
 def test_stack_unstack_roundtrip(cfg, params):
     man = StageManifest.for_config(cfg, 4)
     rt = pl.unstack_stages(pl.stack_stages(params, man), man)
