@@ -31,6 +31,7 @@ import orbax.checkpoint as ocp
 
 from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
 from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+from llama_pipeline_parallel_tpu.parallel import distributed as dist
 from llama_pipeline_parallel_tpu.parallel import pipeline as pl
 from llama_pipeline_parallel_tpu.utils.logging import get_logger
 
@@ -119,19 +120,27 @@ class CheckpointManager:
                              _canonicalize_moments(opt_state, manifest, to_canonical=True),
                              force=True)
         # StandardCheckpointer writes asynchronously; the tag/meta below must
-        # only appear once the array data is durably on disk.
+        # only appear once the array data is durably on disk — on EVERY
+        # process, not just this one. Barrier first, then let a single
+        # process write the completeness marker and tag (concurrent writers
+        # of the same shared-storage file would race, and a fast process
+        # could otherwise mark the checkpoint complete while a peer's Orbax
+        # writes are still in flight).
         self._ckptr.wait_until_finished()
-        meta = {
-            "step": step,
-            "manifest": dataclasses.asdict(manifest),
-            "model_config": _config_meta(cfg),
-            "has_optimizer_state": opt_state is not None,
-            "format_version": 1,
-        }
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=2)
-        with open(os.path.join(self.root, LATEST_TAG), "w") as f:
-            f.write(f"checkpoint-{step}")
+        dist.barrier(f"ckpt-arrays-{step}")
+        if jax.process_index() == 0:
+            meta = {
+                "step": step,
+                "manifest": dataclasses.asdict(manifest),
+                "model_config": _config_meta(cfg),
+                "has_optimizer_state": opt_state is not None,
+                "format_version": 1,
+            }
+            with open(os.path.join(path, "meta.json"), "w") as f:
+                json.dump(meta, f, indent=2)
+            with open(os.path.join(self.root, LATEST_TAG), "w") as f:
+                f.write(f"checkpoint-{step}")
+        dist.barrier(f"ckpt-commit-{step}")
         logger.info("saved checkpoint-%d to %s", step, path)
         return path
 

@@ -24,7 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from llama_pipeline_parallel_tpu.models.llama import model as llama_model
 from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
-from llama_pipeline_parallel_tpu.parallel.mesh import AXIS_DP
+from llama_pipeline_parallel_tpu.parallel.mesh import AXIS_DP, AXIS_PP
 from llama_pipeline_parallel_tpu.parallel.pipeline import (
     PipelineConfig,
     make_pipeline_loss_and_grad,
@@ -51,13 +51,17 @@ def _zero1_leaf_spec(param_spec: P, shape: tuple[int, ...], dp_size: int) -> P:
     Scans from the trailing (feature) dim backwards so tp-sharded weights
     (whose last dim already carries 'tp') still get their moments dp-sharded
     on another dim — otherwise a pp x tp x dp run would silently keep the
-    column-parallel moments (most of the bytes) dp-replicated. The leading
-    stage dim (index 0 of stacked leaves, 'pp') is never touched.
+    column-parallel moments (most of the bytes) dp-replicated. Dim 0 is a
+    valid fallback for NON-stacked leaves (embed/lm_head have no leading
+    stage axis — without it the vocab-parallel lm_head [d, V/tp] moments,
+    the largest non-stacked leaves, would stay fully dp-replicated); for
+    stage-stacked layer leaves dim 0 carries 'pp' and is never touched.
     """
-    if len(shape) < 2 or dp_size == 1:
+    if not shape or dp_size == 1:
         return param_spec
     spec = list(param_spec) + [None] * (len(shape) - len(param_spec))
-    for dim in range(len(shape) - 1, 0, -1):
+    lowest_dim = 1 if spec[0] == AXIS_PP else 0
+    for dim in range(len(shape) - 1, lowest_dim - 1, -1):
         if spec[dim] is None and shape[dim] % dp_size == 0:
             spec[dim] = AXIS_DP
             return P(*spec)

@@ -330,6 +330,15 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
     # the reference had only DeepSpeed's steps_per_print throughput line).
     # Clamped into [resume_step, end_step] so resume/short runs stay safe.
     profile_window = cfg.get("profile_steps")
+    if profile_window:
+        lo = max(int(profile_window[0]), resume_step)
+        hi = min(int(profile_window[1]), end_step)
+        if lo >= hi:
+            logger.info("profile_steps %s empty after clamping to [%d, %d); "
+                        "skipping trace", list(profile_window), resume_step, end_step)
+            profile_window = None
+        else:
+            profile_window = (lo, hi)
     trace_active = False
 
     it: Iterator = iter(RepeatingLoader(loader))
@@ -397,6 +406,9 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                 do_save(step + 1)
                 last_saved = step + 1
     finally:
+        if trace_active:  # preemption break / exception inside the window
+            jax.profiler.stop_trace()
+            logger.info("profiler trace (early exit) written to %s/profile", output_dir)
         for sig, handler in previous_handlers.items():
             signal.signal(sig, handler)
         writer.close()
