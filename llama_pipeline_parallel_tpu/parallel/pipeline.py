@@ -13,14 +13,18 @@ Design (and why it is not a translation of DeepSpeed):
   (the analogue of `LayerSpec` lazy per-rank materialization, reference
   models/llama_ds_mp_wrap.py:209-224, but by sharding, not by construction
   order).
-- The schedule is a skewed microbatch loop ("GPipe-with-flush"): at tick t,
-  stage s computes microbatch t-s; activations hop to the next stage via
-  `jax.lax.ppermute` over the ICI ring (the analogue of NCCL P2P send/recv).
-  JAX autodiff of the loop yields the backward pipeline automatically — the
-  transpose of `ppermute` is the reverse `ppermute`, so backward activations
-  flow stage N -> N-1 exactly like DeepSpeed's backward P2P, without a
-  hand-written backward schedule. Per-layer remat (`jax.checkpoint`) bounds
-  stored activations, mirroring `deepspeed.checkpointing.checkpoint`
+- Two schedules, both skewed microbatch loops where activations hop to the
+  next stage via `jax.lax.ppermute` over the ICI ring (the analogue of NCCL
+  P2P send/recv):
+  * "1f1b" (default) — the schedule DeepSpeed's engine runs: forward and
+    backward interleave in one scan with a hand-written per-stage `jax.vjp`
+    backward, bounding in-flight activations at min(2S-1, M) stage inputs
+    (see `_pipeline_1f1b_local`).
+  * "gpipe" — forward-only scan; JAX autodiff yields the backward pipeline
+    automatically (the transpose of `ppermute` is the reverse `ppermute`),
+    at the cost of O(M) stored boundary activations.
+  Per-layer remat (`jax.checkpoint`) bounds within-stage activations,
+  mirroring `deepspeed.checkpointing.checkpoint`
   (reference models/llama_ds_mp_wrap.py:57,166).
 - Embed / final-norm / lm-head params are replicated over `pp`; only the
   first/last stage's contribution survives masking, and their gradients are
@@ -64,6 +68,9 @@ Params = dict
 Batch = dict
 
 
+SCHEDULES = ("1f1b", "gpipe")
+
+
 @dataclasses.dataclass(frozen=True)
 class PipelineConfig:
     """Schedule knobs (reference: `num_stages` conf yaml:24,
@@ -73,12 +80,19 @@ class PipelineConfig:
     num_microbatches: int
     remat: bool = True
     remat_policy: str = "nothing_saveable"
+    # "1f1b" (default): one-forward-one-backward with a hand-written backward
+    # — in-flight activations bounded at min(2*num_stages-1, M) stage inputs
+    # regardless of M, with the single (num_stages-1)-tick flush bubble (the
+    # schedule DeepSpeed's engine runs inside the reference's
+    # `engine.train_batch`, trainer_base_ds_mp.py:354).
+    # "gpipe": forward-only scan differentiated by AD — simpler graph, but
+    # stores one stage-boundary activation per tick, so memory grows with M.
+    schedule: str = "1f1b"
     # Split the microbatches into this many sequential pipeline flushes within
-    # ONE jitted step. Activation memory scales with num_microbatches/chunks
-    # (each flush's stage-boundary activations are freed before the next),
-    # at the price of one extra (num_stages-1)-tick bubble per chunk. The
-    # knob that makes grad-accum 256 runs fit: e.g. chunks=8 at M=256 stores
-    # 32 microbatches of activations instead of 256 for a ~15% bubble.
+    # ONE jitted step, at the price of one extra (num_stages-1)-tick bubble
+    # per chunk. Under "gpipe" this is the only memory bound (chunks=8 at
+    # M=256 stores 32 microbatches of activations); under "1f1b" memory is
+    # already bounded by the schedule and chunks are rarely worth the bubble.
     accum_chunks: int = 1
 
     def __post_init__(self) -> None:
@@ -86,6 +100,8 @@ class PipelineConfig:
             raise ValueError("num_microbatches must be >= 1")
         if self.num_stages < 1:
             raise ValueError("num_stages must be >= 1")
+        if self.schedule not in SCHEDULES:
+            raise ValueError(f"unknown schedule {self.schedule!r}; choose one of {SCHEDULES}")
         if self.accum_chunks < 1 or self.num_microbatches % self.accum_chunks:
             raise ValueError(
                 f"accum_chunks={self.accum_chunks} must divide "
@@ -287,6 +303,174 @@ def _pipeline_loss_local(
     return loss_sum, count
 
 
+def _pipeline_1f1b_local(
+    params: Params,
+    batch: Batch,
+    cfg: LlamaConfig,
+    pcfg: PipelineConfig,
+    attn_fn: Callable,
+    global_count: jnp.ndarray,
+) -> tuple[jnp.ndarray, Params]:
+    """One-forward-one-backward schedule with a hand-written backward.
+
+    Runs INSIDE shard_map; returns this shard's (normalized loss, grads) —
+    the caller psums. This is the schedule DeepSpeed's engine runs inside the
+    reference's `engine.train_batch` (reference trainer_base_ds_mp.py:354):
+    once the pipeline fills, every stage alternates one microbatch forward
+    with one microbatch backward, so in-flight activations are bounded at
+    min(2*num_stages-1, M) stage INPUTS no matter how large the
+    grad-accumulation M is — where the AD-differentiated GPipe scan stores
+    one boundary activation per tick (O(M)) and needs `accum_chunks` flushes
+    (each costing an extra bubble) to stay within HBM.
+
+    How the backward is built without AD-of-the-loop: each tick calls
+    `jax.vjp` on the STAGE function at the microbatch being backpropped,
+    recomputing its forward from the buffered stage input — exactly
+    DeepSpeed's activation-checkpointing contract (store the stage boundary,
+    recompute the stage in backward; reference models/llama_ds_mp_wrap.py:57).
+    Timeline (tick t, stage s, S stages, M microbatches):
+
+        forward  of microbatch t - s
+        backward of microbatch t - (2S - 2 - s)
+
+    so the last stage backprops a microbatch the same tick it finishes it,
+    and stage s holds at most 2(S-s)-1 live inputs. Activation cotangents hop
+    backwards over the same ICI ring the forwards hop over (`ppermute` with
+    the reversed permutation — NCCL backward-P2P analogue).
+
+    Embed and the loss head run under `lax.cond` on the stage index: only
+    stage 0 pays the embedding gather (and its backward scatter into [V, d]),
+    only the last stage pays final-norm + lm-head + CE. All collectives
+    inside the cond branches (the tp ops of the vocab-parallel loss) are over
+    the `tp` axis, whose members share a pipeline-stage index and therefore
+    take the same branch — no divergent-collective deadlock.
+    """
+    s_total = pcfg.num_stages
+    m_total = pcfg.num_microbatches
+    stage = jax.lax.axis_index(AXIS_PP)
+    is_first = stage == 0
+    is_last = stage == s_total - 1
+    tp_size = jax.lax.axis_size(AXIS_TP)
+    tp_axis = AXIS_TP if tp_size > 1 else None
+
+    ids = batch["input_ids"]
+    bsz, seqlen = ids.shape
+    if bsz % m_total:
+        raise ValueError(f"per-dp batch {bsz} not divisible by microbatches {m_total}")
+    mb = bsz // m_total
+
+    def mb_view(x):
+        return x.reshape((m_total, mb) + x.shape[1:])
+
+    ids_m = mb_view(ids)
+    mask_m = mb_view(batch["attention_mask"]) if batch.get("attention_mask") is not None else None
+    pos_m = mb_view(batch["position_ids"]) if batch.get("position_ids") is not None else None
+    labels_m = mb_view(batch["labels"])
+
+    def mb_data(idx):
+        my_ids = jax.lax.dynamic_index_in_dim(ids_m, idx, keepdims=False)
+        if pos_m is not None:
+            pos = jax.lax.dynamic_index_in_dim(pos_m, idx, keepdims=False)
+        else:
+            pos = jnp.broadcast_to(jnp.arange(seqlen, dtype=jnp.int32), (mb, seqlen))
+        pad = (jax.lax.dynamic_index_in_dim(mask_m, idx, keepdims=False)
+               if mask_m is not None else None)
+        labels = jax.lax.dynamic_index_in_dim(labels_m, idx, keepdims=False)
+        cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta, dtype=cfg.dtype)
+        return my_ids, pad, cos, sin, labels
+
+    def stage_fwd(p, x_in, my_ids, pad, cos, sin, labels, with_loss):
+        x0 = jax.lax.cond(
+            is_first,
+            lambda emb, x: llama.embed({"embed": emb}, my_ids, cfg),
+            lambda emb, x: x,
+            p["embed"], x_in)
+        local_layers = jax.tree.map(lambda a: a[0], p["layers"])
+        y = llama.run_layers(local_layers, x0, pad, cos, sin, cfg, attn_fn=attn_fn,
+                             remat=pcfg.remat, tp_axis=tp_axis,
+                             remat_policy=pcfg.remat_policy)
+        if not with_loss:
+            return y
+
+        def head_branch(norm_w, head_w, y_):
+            h = llama.final_norm({"norm": norm_w}, y_, cfg)
+            if tp_size > 1:
+                return _vocab_parallel_token_loss({"lm_head": head_w}, h, labels, cfg)[0]
+            logits = llama.lm_head({"lm_head": head_w}, h, cfg)
+            return llama.token_loss_sum_and_count(logits, labels)[0]
+
+        mb_sum = jax.lax.cond(
+            is_last, head_branch, lambda norm_w, head_w, y_: jnp.float32(0.0),
+            p["norm"], p["lm_head"], y)
+        return y, mb_sum
+
+    num_ticks = m_total + 2 * (s_total - 1)
+    b_slots = min(2 * s_total - 1, m_total)
+    hidden_shape = (mb, seqlen, cfg.hidden_size)
+
+    def tick(carry, t):
+        x_recv, dy_recv, xbuf, gacc, loss_acc = carry
+
+        # -- forward half: microbatch t - stage ---------------------------
+        fm = t - stage
+        f_valid = (fm >= 0) & (fm < m_total)
+        fm_c = jnp.clip(fm, 0, m_total - 1)
+        ids_f, pad_f, cos_f, sin_f, _ = mb_data(fm_c)
+        y_f = stage_fwd(params, x_recv, ids_f, pad_f, cos_f, sin_f, None,
+                        with_loss=False)
+        # Buffer the raw received stage input for the later backward
+        # recompute (slot is free: a colliding index would be >= b_slots
+        # microbatches old, past its backward tick). The write is still
+        # predicated so drain-phase ticks (fm clipped onto m_total-1) can
+        # never clobber a live slot.
+        slot_f = fm_c % b_slots
+        old = jax.lax.dynamic_index_in_dim(xbuf, slot_f, keepdims=False)
+        xbuf = jax.lax.dynamic_update_index_in_dim(
+            xbuf, jnp.where(f_valid, x_recv, old), slot_f, 0)
+
+        # -- backward half: microbatch t - (2S - 2 - stage) ---------------
+        bm = t - (2 * (s_total - 1) - stage)
+        b_valid = (bm >= 0) & (bm < m_total)
+        bm_c = jnp.clip(bm, 0, m_total - 1)
+        ids_b, pad_b, cos_b, sin_b, labels_b = mb_data(bm_c)
+        x_in_b = jax.lax.dynamic_index_in_dim(xbuf, bm_c % b_slots, keepdims=False)
+
+        def h(p, x_in):
+            return stage_fwd(p, x_in, ids_b, pad_b, cos_b, sin_b, labels_b,
+                             with_loss=True)
+
+        (_, mb_sum), pullback = jax.vjp(h, params, x_in_b)
+        # vjp is linear in the cotangent, so masked-out ticks (zero seeds)
+        # contribute exactly zero to the accumulators — no outer `where`.
+        dy_ct = jnp.where(b_valid & ~is_last, 1.0, 0.0).astype(cfg.dtype) * dy_recv
+        loss_ct = jnp.where(b_valid, 1.0, 0.0) / global_count
+        dparams, dx = pullback((dy_ct, loss_ct))
+        gacc = jax.tree.map(jnp.add, gacc, dparams)
+        loss_acc = loss_acc + jnp.where(b_valid, mb_sum, 0.0)
+
+        # -- handoffs over the ICI ring -----------------------------------
+        if s_total > 1:
+            fwd_perm = [(i, (i + 1) % s_total) for i in range(s_total)]
+            bwd_perm = [(i, (i - 1) % s_total) for i in range(s_total)]
+            x_next = jax.lax.ppermute(y_f, AXIS_PP, fwd_perm)
+            dy_next = jax.lax.ppermute(dx, AXIS_PP, bwd_perm)
+        else:
+            x_next, dy_next = y_f, dx
+        return (x_next, dy_next, xbuf, gacc, loss_acc), None
+
+    carry0 = (
+        jnp.zeros(hidden_shape, cfg.dtype),
+        jnp.zeros(hidden_shape, cfg.dtype),
+        jnp.zeros((b_slots,) + hidden_shape, cfg.dtype),
+        jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), params),
+        jnp.float32(0.0),
+    )
+    (_, _, _, grads, loss_acc), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(num_ticks))
+    # loss_acc is nonzero on the last stage only (cond zero branch elsewhere)
+    return loss_acc / global_count, grads
+
+
 def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn):
     """shard_map body: global-mean loss + fully reduced grads.
 
@@ -305,12 +489,20 @@ def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn):
     chunk_pcfg = dataclasses.replace(
         pcfg, num_microbatches=pcfg.num_microbatches // chunks, accum_chunks=1)
 
-    def chunk_loss(p, chunk_batch):
-        loss_sum, _ = _pipeline_loss_local(p, chunk_batch, cfg, chunk_pcfg, attn_fn)
-        return loss_sum / global_count  # nonzero on the last stage only
+    if pcfg.schedule == "1f1b":
+        def chunk_loss_and_grad(p, chunk_batch):
+            return _pipeline_1f1b_local(p, chunk_batch, cfg, chunk_pcfg, attn_fn,
+                                        global_count)
+    else:
+        def chunk_loss(p, chunk_batch):
+            loss_sum, _ = _pipeline_loss_local(p, chunk_batch, cfg, chunk_pcfg, attn_fn)
+            return loss_sum / global_count  # nonzero on the last stage only
+
+        def chunk_loss_and_grad(p, chunk_batch):
+            return jax.value_and_grad(chunk_loss)(p, chunk_batch)
 
     if chunks == 1:
-        local_loss, grads = jax.value_and_grad(chunk_loss)(params, batch)
+        local_loss, grads = chunk_loss_and_grad(params, batch)
     else:
         # Sequential pipeline flushes: each chunk's fwd+bwd completes (and its
         # activations are freed) before the next starts; grads accumulate in
@@ -321,7 +513,7 @@ def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn):
 
         def accum(carry, chunk_batch):
             acc_loss, acc_grads = carry
-            l, g = jax.value_and_grad(chunk_loss)(params, chunk_batch)
+            l, g = chunk_loss_and_grad(params, chunk_batch)
             return (acc_loss + l, jax.tree.map(jnp.add, acc_grads, g)), None
 
         zero_grads = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), params)

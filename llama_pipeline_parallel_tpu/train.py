@@ -147,6 +147,7 @@ def run_training(cfg: dict) -> dict:
         num_microbatches=cfg.get("gradient_accumulation_steps", 1),
         remat=cfg.get("activation_checkpointing", True),
         remat_policy=cfg.get("remat_policy", "nothing_saveable"),
+        schedule=cfg.get("pipeline_schedule", "1f1b"),
         accum_chunks=cfg.get("gradient_accumulation_chunks", 1))
 
     dataset, collator = build_dataset_and_collator(cfg, model_cfg)

@@ -54,12 +54,13 @@ def reference_loss_and_grad(params, batch, cfg):
     return jax.value_and_grad(loss)(params)
 
 
-def run_pipeline(params, batch, cfg, pp, dp, microbatches, remat=True, chunks=1):
+def run_pipeline(params, batch, cfg, pp, dp, microbatches, remat=True, chunks=1,
+                 schedule="1f1b"):
     mesh = make_mesh(MeshConfig(pp=pp, dp=dp))
     manifest = StageManifest.for_config(cfg, pp)
     stacked = pl.stack_stages(params, manifest)
     pcfg = pl.PipelineConfig(num_stages=pp, num_microbatches=microbatches,
-                             remat=remat, accum_chunks=chunks)
+                             remat=remat, accum_chunks=chunks, schedule=schedule)
     fn = jax.jit(pl.make_pipeline_loss_and_grad(mesh, cfg, pcfg, stacked))
     loss, grads = fn(stacked, batch)
     return loss, pl.unstack_stages(grads, manifest)
@@ -88,15 +89,43 @@ def test_pp_matches_reference(cfg, params, devices, pp, dp, microbatches):
     assert_tree_close(grads, ref_grads)
 
 
-@pytest.mark.parametrize("chunks", [2, 4])
-def test_chunked_accumulation_matches(cfg, params, devices, chunks):
-    """accum_chunks splits the flush without changing loss or gradients."""
+@pytest.mark.parametrize("chunks,schedule", [(2, "1f1b"), (4, "1f1b"), (2, "gpipe")])
+def test_chunked_accumulation_matches(cfg, params, devices, chunks, schedule):
+    """accum_chunks splits the flush without changing loss or gradients —
+    under both schedules (chunks are the gpipe path's only memory bound)."""
     batch = make_batch(cfg, batch_size=8)
     ref_loss, ref_grads = reference_loss_and_grad(params, batch, cfg)
     loss, grads = run_pipeline(params, batch, cfg, pp=4, dp=1, microbatches=4,
-                               chunks=chunks)
+                               chunks=chunks, schedule=schedule)
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
     assert_tree_close(grads, ref_grads)
+
+
+def test_gpipe_schedule_matches(cfg, params, devices):
+    """The legacy AD-differentiated GPipe schedule stays available and exact."""
+    batch = make_batch(cfg)
+    ref_loss, ref_grads = reference_loss_and_grad(params, batch, cfg)
+    loss, grads = run_pipeline(params, batch, cfg, pp=4, dp=1, microbatches=4,
+                               schedule="gpipe")
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    assert_tree_close(grads, ref_grads)
+
+
+@pytest.mark.parametrize("pp,microbatches", [(4, 2), (4, 4), (2, 1)])
+def test_1f1b_fewer_microbatches_than_stages(cfg, params, devices, pp, microbatches):
+    """1F1B edge cases M < S, M == S, M == 1: the warmup/drain masking and the
+    min(2S-1, M) input ring buffer must stay exact when the pipe never fills."""
+    batch = make_batch(cfg, batch_size=microbatches * 2)
+    ref_loss, ref_grads = reference_loss_and_grad(params, batch, cfg)
+    loss, grads = run_pipeline(params, batch, cfg, pp=pp, dp=1,
+                               microbatches=microbatches)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    assert_tree_close(grads, ref_grads)
+
+
+def test_bad_schedule():
+    with pytest.raises(ValueError, match="schedule"):
+        pl.PipelineConfig(num_stages=2, num_microbatches=4, schedule="pipedream")
 
 
 def test_bad_chunks():
