@@ -237,6 +237,22 @@ def forward(
 # Loss
 # ---------------------------------------------------------------------------
 
+def token_loss_sum_and_count_preshifted(
+    logits: jnp.ndarray, target_labels: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """CE where `target_labels[:, i]` is already the next-token target for
+    `logits[:, i]` (positions with no target carry IGNORE_INDEX). This is the
+    form sequence-parallel shards need: the causal shift crosses sp-shard
+    boundaries, so the caller aligns targets (parallel/pipeline.py
+    `_sp_shift_labels`) and the loss itself stays shard-local."""
+    valid = target_labels != IGNORE_INDEX
+    safe_labels = jnp.where(valid, target_labels, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    token_ll = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    loss_sum = jnp.where(valid, -token_ll, 0.0).sum()
+    return loss_sum, valid.sum()
+
+
 def token_loss_sum_and_count(logits: jnp.ndarray, labels: jnp.ndarray
                              ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Shifted causal-LM cross-entropy: (sum of token losses, valid-token count).
@@ -245,14 +261,7 @@ def token_loss_sum_and_count(logits: jnp.ndarray, labels: jnp.ndarray
     both the single-device loss below and the pipeline's last-stage loss
     (parallel/pipeline.py) build on it, so they cannot drift apart.
     """
-    shift_logits = logits[:, :-1, :]
-    shift_labels = labels[:, 1:]
-    valid = shift_labels != IGNORE_INDEX
-    safe_labels = jnp.where(valid, shift_labels, 0)
-    logp = jax.nn.log_softmax(shift_logits.astype(jnp.float32), axis=-1)
-    token_ll = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
-    loss_sum = jnp.where(valid, -token_ll, 0.0).sum()
-    return loss_sum, valid.sum()
+    return token_loss_sum_and_count_preshifted(logits[:, :-1, :], labels[:, 1:])
 
 
 def loss_fn(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:

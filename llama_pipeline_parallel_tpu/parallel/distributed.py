@@ -61,26 +61,38 @@ def barrier(tag: str = "sync") -> None:
 
 
 def form_global_batch(mesh: Mesh, host_batch: Mapping[str, np.ndarray]) -> dict:
-    """Assemble the global dp-sharded batch from per-host data.
+    """Assemble the global (dp, sp)-sharded batch from per-host data.
 
-    Single-process: the host batch IS the global batch (placed sharded).
+    Single-process: the host batch IS the global batch (placed sharded:
+    batch dim over dp, sequence dim over sp).
     Multi-host: each process loads only its processes' dp shards (rows
     [dp_rank_of_host * per_replica : ...]) and the global jax.Array is formed
     from process-local shards without any cross-host gather — the TPU-world
     equivalent of the reference's rule that only data-consuming ranks run
-    real DataLoaders (reference README.md:64-129).
+    real DataLoaders (reference README.md:64-129). Hosts always load FULL
+    sequences; when the mesh has an sp axis the sequence dim is then
+    resharded on-device (one slab exchange over ICI per step — loaders stay
+    oblivious to sequence sharding).
     """
-    sharding = NamedSharding(mesh, P(AXIS_DP))
+    from llama_pipeline_parallel_tpu.parallel.pipeline import batch_specs
+
+    specs = batch_specs(mesh)
     if jax.process_count() == 1:
-        return {k: jax.device_put(np.asarray(v), sharding)
+        return {k: jax.device_put(np.asarray(v), NamedSharding(mesh, specs[k]))
                 for k, v in host_batch.items()}
     from jax.experimental import multihost_utils
 
-    return {
+    global_batch = {
         k: multihost_utils.host_local_array_to_global_array(
             np.asarray(v), mesh, P(AXIS_DP))
         for k, v in host_batch.items()
     }
+    if mesh.shape["sp"] > 1:
+        # device_put reshards committed global arrays without building (and
+        # re-tracing) a jit wrapper per step
+        global_batch = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+                        for k, v in global_batch.items()}
+    return global_batch
 
 
 def host_dp_shard(mesh: Mesh) -> tuple[int, int]:

@@ -62,7 +62,13 @@ from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
 from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
 from llama_pipeline_parallel_tpu.ops.attention import attention
 from llama_pipeline_parallel_tpu.ops.rope import rope_cos_sin
-from llama_pipeline_parallel_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_TP
+from llama_pipeline_parallel_tpu.parallel.sp import make_sp_attention
+from llama_pipeline_parallel_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_PP,
+    AXIS_SP,
+    AXIS_TP,
+)
 
 Params = dict
 Batch = dict
@@ -94,8 +100,18 @@ class PipelineConfig:
     # M=256 stores 32 microbatches of activations); under "1f1b" memory is
     # already bounded by the schedule and chunks are rarely worth the bubble.
     accum_chunks: int = 1
+    # Attention strategy when the mesh's sp axis > 1: "ring" rotates KV slabs
+    # around the ICI ring (parallel/ring_attention.py), "ulysses" re-shards
+    # head-wise via all-to-all (parallel/ulysses.py). Ignored at sp=1.
+    sequence_parallel: str = "ring"
 
     def __post_init__(self) -> None:
+        from llama_pipeline_parallel_tpu.parallel.sp import SP_STRATEGIES
+
+        if self.sequence_parallel not in SP_STRATEGIES:
+            raise ValueError(
+                f"unknown sequence_parallel {self.sequence_parallel!r}; "
+                f"choose one of {SP_STRATEGIES}")
         if self.num_microbatches < 1:
             raise ValueError("num_microbatches must be >= 1")
         if self.num_stages < 1:
@@ -159,8 +175,29 @@ def stage_param_specs(params: Params, tp: bool = False) -> Params:
     return specs
 
 
+def _sp_shift_labels(labels: jnp.ndarray, sp_size: int) -> jnp.ndarray:
+    """Align next-token targets with a sequence-sharded label slab.
+
+    The causal shift crosses sp-shard boundaries: the target for this slab's
+    last position is the NEXT slab's first label, fetched with one tiny
+    `ppermute` (labels are integers — no gradient flows, so a bare collective
+    is safe inside the differentiated region). The global last position gets
+    IGNORE_INDEX (no target exists). At sp=1 this degenerates to the plain
+    shift with an IGNORE-padded tail.
+    """
+    if sp_size == 1:
+        tail = jnp.full_like(labels[:, :1], llama.IGNORE_INDEX)
+    else:
+        perm = [(i, (i - 1) % sp_size) for i in range(sp_size)]
+        tail = jax.lax.ppermute(labels[:, :1], AXIS_SP, perm)
+        is_global_last = jax.lax.axis_index(AXIS_SP) == sp_size - 1
+        tail = jnp.where(is_global_last, llama.IGNORE_INDEX, tail)
+    return jnp.concatenate([labels[:, 1:], tail], axis=1)
+
+
 def _vocab_parallel_token_loss(params: Params, h: jnp.ndarray, labels: jnp.ndarray,
-                               cfg: LlamaConfig) -> tuple[jnp.ndarray, jnp.ndarray]:
+                               cfg: LlamaConfig, preshifted: bool = False
+                               ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Shifted CE with the lm_head vocab-sharded over tp.
 
     Each rank computes logits only for its vocab shard; the log-sum-exp and
@@ -169,6 +206,9 @@ def _vocab_parallel_token_loss(params: Params, h: jnp.ndarray, labels: jnp.ndarr
     bare psum inside the differentiated region would double-count, see
     _loss_and_grad_local). The row max used for stability goes through
     `tp_max` (zero-gradient pmax), so the softmax gradient stays exact.
+
+    `preshifted`: labels are already next-token targets aligned with h
+    (the sequence-parallel form, see _sp_shift_labels).
     """
     from llama_pipeline_parallel_tpu.parallel.tp import tp_copy, tp_max, tp_reduce
 
@@ -176,8 +216,10 @@ def _vocab_parallel_token_loss(params: Params, h: jnp.ndarray, labels: jnp.ndarr
     # column-parallel matmul: replicated h fans into vocab shards, so dh must
     # be psum'd across tp in backward (the Megatron f operator)
     logits = (tp_copy(h, AXIS_TP) @ head_local).astype(jnp.float32)  # [b, s, V/n]
-    shift_logits = logits[:, :-1, :]
-    shift_labels = labels[:, 1:]
+    if preshifted:
+        shift_logits, shift_labels = logits, labels
+    else:
+        shift_logits, shift_labels = logits[:, :-1, :], labels[:, 1:]
     valid = shift_labels != llama.IGNORE_INDEX
 
     v_local = shift_logits.shape[-1]
@@ -227,25 +269,32 @@ def _pipeline_loss_local(
     def mb_view(x):
         return x.reshape((m_total, mb) + x.shape[1:])
 
-    ids_m = mb_view(ids)
-    mask_m = mb_view(batch["attention_mask"]) if batch.get("attention_mask") is not None else None
-    pos_m = mb_view(batch["position_ids"]) if batch.get("position_ids") is not None else None
-    labels_m = mb_view(batch["labels"])
-
     num_ticks = m_total + s_total - 1
     hidden_shape = (mb, seqlen, cfg.hidden_size)
     x_init = jnp.zeros(hidden_shape, cfg.dtype)
     tp_size = jax.lax.axis_size(AXIS_TP)
+    sp_size = jax.lax.axis_size(AXIS_SP)
+    # seqlen here is the LOCAL slab length; fallback positions must be global
+    sp_pos_base = jax.lax.axis_index(AXIS_SP) * seqlen if sp_size > 1 else 0
 
-    def mb_loss(h, labels):
+    ids_m = mb_view(ids)
+    mask_m = mb_view(batch["attention_mask"]) if batch.get("attention_mask") is not None else None
+    pos_m = mb_view(batch["position_ids"]) if batch.get("position_ids") is not None else None
+    # Next-token targets, shifted ONCE for the whole chunk (batch-dim
+    # microbatch slicing commutes with the sequence-dim shift; under sp the
+    # shift is a collective, kept off the per-tick path)
+    targets_m = mb_view(_sp_shift_labels(batch["labels"], sp_size))
+
+    def mb_loss(h, targets):
         """Per-microbatch loss from last-stage hiddens. Checkpointed in the
         tick so the [mb, L, vocab] logits are recomputed in backward from the
         (already stored) hiddens — never M copies of logits."""
         h = llama.final_norm(params, h, cfg)
         if tp_size > 1:
-            return _vocab_parallel_token_loss(params, h, labels, cfg)
+            return _vocab_parallel_token_loss(params, h, targets, cfg,
+                                              preshifted=True)
         logits = llama.lm_head(params, h, cfg)
-        return llama.token_loss_sum_and_count(logits, labels)
+        return llama.token_loss_sum_and_count_preshifted(logits, targets)
 
     mb_loss = jax.checkpoint(mb_loss)
 
@@ -265,7 +314,8 @@ def _pipeline_loss_local(
         if pos_m is not None:
             pos = jax.lax.dynamic_index_in_dim(pos_m, mb_idx, keepdims=False)
         else:
-            pos = jnp.broadcast_to(jnp.arange(seqlen, dtype=jnp.int32), (mb, seqlen))
+            pos = sp_pos_base + jnp.broadcast_to(
+                jnp.arange(seqlen, dtype=jnp.int32), (mb, seqlen))
         if mask_m is not None:
             pad_mask = jax.lax.dynamic_index_in_dim(mask_m, mb_idx, keepdims=False)
         else:
@@ -280,8 +330,8 @@ def _pipeline_loss_local(
         # The last stage's finished microbatch contributes its loss in-tick
         # (nothing is collected into an M-sized buffer; lm-head cost per tick
         # is a few percent of a stage's decoder layers at real sizes).
-        labels = jax.lax.dynamic_index_in_dim(labels_m, mb_idx, keepdims=False)
-        mb_sum, mb_count = mb_loss(y, labels)
+        targets = jax.lax.dynamic_index_in_dim(targets_m, mb_idx, keepdims=False)
+        mb_sum, mb_count = mb_loss(y, targets)
         take = is_last & (my_idx >= 0)
         loss_sum = loss_sum + jnp.where(take, mb_sum, 0.0)
         count = count + jnp.where(take, mb_count, 0)
@@ -340,10 +390,11 @@ def _pipeline_1f1b_local(
 
     Embed and the loss head run under `lax.cond` on the stage index: only
     stage 0 pays the embedding gather (and its backward scatter into [V, d]),
-    only the last stage pays final-norm + lm-head + CE. All collectives
-    inside the cond branches (the tp ops of the vocab-parallel loss) are over
-    the `tp` axis, whose members share a pipeline-stage index and therefore
-    take the same branch — no divergent-collective deadlock.
+    only the last stage pays final-norm + lm-head + CE. The cond branches
+    must stay COLLECTIVE-FREE — a collective executed by only some devices
+    aborts/deadlocks the runtime — so the sp label shift is hoisted out to
+    batch level, and the tp>1 vocab-parallel head (tp psums inside) falls
+    back to where-masked computation on every stage instead of cond.
     """
     s_total = pcfg.num_stages
     m_total = pcfg.num_microbatches
@@ -352,12 +403,15 @@ def _pipeline_1f1b_local(
     is_last = stage == s_total - 1
     tp_size = jax.lax.axis_size(AXIS_TP)
     tp_axis = AXIS_TP if tp_size > 1 else None
+    sp_size = jax.lax.axis_size(AXIS_SP)
 
     ids = batch["input_ids"]
     bsz, seqlen = ids.shape
     if bsz % m_total:
         raise ValueError(f"per-dp batch {bsz} not divisible by microbatches {m_total}")
     mb = bsz // m_total
+    # seqlen here is the LOCAL slab length; fallback positions must be global
+    sp_pos_base = jax.lax.axis_index(AXIS_SP) * seqlen if sp_size > 1 else 0
 
     def mb_view(x):
         return x.reshape((m_total, mb) + x.shape[1:])
@@ -365,21 +419,31 @@ def _pipeline_1f1b_local(
     ids_m = mb_view(ids)
     mask_m = mb_view(batch["attention_mask"]) if batch.get("attention_mask") is not None else None
     pos_m = mb_view(batch["position_ids"]) if batch.get("position_ids") is not None else None
-    labels_m = mb_view(batch["labels"])
+    # Pre-shift to next-token targets ONCE for the whole chunk (microbatch
+    # slicing is over the batch dim, so it commutes with the sequence-dim
+    # shift): under sp the shift is a collective, and hoisting it here keeps
+    # it off the schedule's per-tick critical path AND stage-uniform.
+    targets_m = mb_view(_sp_shift_labels(batch["labels"], sp_size))
 
     def mb_data(idx):
         my_ids = jax.lax.dynamic_index_in_dim(ids_m, idx, keepdims=False)
         if pos_m is not None:
             pos = jax.lax.dynamic_index_in_dim(pos_m, idx, keepdims=False)
         else:
-            pos = jnp.broadcast_to(jnp.arange(seqlen, dtype=jnp.int32), (mb, seqlen))
+            pos = sp_pos_base + jnp.broadcast_to(
+                jnp.arange(seqlen, dtype=jnp.int32), (mb, seqlen))
         pad = (jax.lax.dynamic_index_in_dim(mask_m, idx, keepdims=False)
                if mask_m is not None else None)
-        labels = jax.lax.dynamic_index_in_dim(labels_m, idx, keepdims=False)
+        targets = jax.lax.dynamic_index_in_dim(targets_m, idx, keepdims=False)
         cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta, dtype=cfg.dtype)
-        return my_ids, pad, cos, sin, labels
+        return my_ids, pad, cos, sin, targets
 
-    def stage_fwd(p, x_in, my_ids, pad, cos, sin, labels, with_loss):
+    def stage_fwd(p, x_in, my_ids, pad, cos, sin, targets, with_loss):
+        """`targets` are next-token labels already aligned with this slab
+        (the sp cross-shard shift happens at TICK level, outside any cond —
+        a collective must never sit inside a stage-divergent branch: only
+        some devices would execute it, which deadlocks/aborts the runtime).
+        """
         x0 = jax.lax.cond(
             is_first,
             lambda emb, x: llama.embed({"embed": emb}, my_ids, cfg),
@@ -395,13 +459,20 @@ def _pipeline_1f1b_local(
         def head_branch(norm_w, head_w, y_):
             h = llama.final_norm({"norm": norm_w}, y_, cfg)
             if tp_size > 1:
-                return _vocab_parallel_token_loss({"lm_head": head_w}, h, labels, cfg)[0]
+                return _vocab_parallel_token_loss({"lm_head": head_w}, h,
+                                                  targets, cfg, preshifted=True)[0]
             logits = llama.lm_head({"lm_head": head_w}, h, cfg)
-            return llama.token_loss_sum_and_count(logits, labels)[0]
+            return llama.token_loss_sum_and_count_preshifted(logits, targets)[0]
 
-        mb_sum = jax.lax.cond(
-            is_last, head_branch, lambda norm_w, head_w, y_: jnp.float32(0.0),
-            p["norm"], p["lm_head"], y)
+        if tp_size > 1:
+            # The vocab-parallel CE contains tp collectives, so it cannot be
+            # cond-gated onto the last stage (see docstring) — compute it
+            # masked on every stage instead, as the gpipe schedule does.
+            mb_sum = jnp.where(is_last, head_branch(p["norm"], p["lm_head"], y), 0.0)
+        else:
+            mb_sum = jax.lax.cond(
+                is_last, head_branch, lambda norm_w, head_w, y_: jnp.float32(0.0),
+                p["norm"], p["lm_head"], y)
         return y, mb_sum
 
     num_ticks = m_total + 2 * (s_total - 1)
@@ -432,11 +503,11 @@ def _pipeline_1f1b_local(
         bm = t - (2 * (s_total - 1) - stage)
         b_valid = (bm >= 0) & (bm < m_total)
         bm_c = jnp.clip(bm, 0, m_total - 1)
-        ids_b, pad_b, cos_b, sin_b, labels_b = mb_data(bm_c)
+        ids_b, pad_b, cos_b, sin_b, targets_b = mb_data(bm_c)
         x_in_b = jax.lax.dynamic_index_in_dim(xbuf, bm_c % b_slots, keepdims=False)
 
         def h(p, x_in):
-            return stage_fwd(p, x_in, ids_b, pad_b, cos_b, sin_b, labels_b,
+            return stage_fwd(p, x_in, ids_b, pad_b, cos_b, sin_b, targets_b,
                              with_loss=True)
 
         (_, mb_sum), pullback = jax.vjp(h, params, x_in_b)
@@ -481,9 +552,12 @@ def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn):
     computed up front and the differentiated function stays psum-free.
     """
     labels = batch["labels"]
-    local_count = (labels[:, 1:] != llama.IGNORE_INDEX).sum()
+    sp_size = jax.lax.axis_size(AXIS_SP)
+    # valid-target count of this shard's slab (sp shards see boundary-crossing
+    # targets via _sp_shift_labels, so counts add up exactly to the global one)
+    local_count = (_sp_shift_labels(labels, sp_size) != llama.IGNORE_INDEX).sum()
     global_count = jnp.maximum(
-        jax.lax.psum(local_count, AXIS_DP), 1).astype(jnp.float32)
+        jax.lax.psum(local_count, (AXIS_DP, AXIS_SP)), 1).astype(jnp.float32)
 
     chunks = pcfg.accum_chunks
     chunk_pcfg = dataclasses.replace(
@@ -519,13 +593,15 @@ def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn):
         zero_grads = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), params)
         (local_loss, grads), _ = jax.lax.scan(
             accum, (jnp.float32(0.0), zero_grads), chunked)
-    loss = jax.lax.psum(local_loss, (AXIS_PP, AXIS_DP))
+    loss = jax.lax.psum(local_loss, (AXIS_PP, AXIS_DP, AXIS_SP))
 
-    # Stage-sharded leaves: reduce across dp replicas only. Replicated leaves
-    # (embed/norm/head): reduce across both so every replica stays identical.
-    grads["layers"] = jax.lax.psum(grads["layers"], AXIS_DP)
+    # Stage-sharded leaves: reduce across dp replicas and sp shards (each sp
+    # shard saw only its sequence slab, so its grads are partial). Replicated
+    # leaves (embed/norm/head): reduce across pp too so every replica stays
+    # identical.
+    grads["layers"] = jax.lax.psum(grads["layers"], (AXIS_DP, AXIS_SP))
     for key in ("embed", "norm", "lm_head"):
-        grads[key] = jax.lax.psum(grads[key], (AXIS_PP, AXIS_DP))
+        grads[key] = jax.lax.psum(grads[key], (AXIS_PP, AXIS_DP, AXIS_SP))
     return loss, grads
 
 
@@ -544,20 +620,22 @@ def make_pipeline_eval_fn(
     trainer has no eval loop at all.
     """
     param_specs = stage_param_specs(params_like, tp=mesh.shape[AXIS_TP] > 1)
-    batch_specs = {
-        "input_ids": P(AXIS_DP), "attention_mask": P(AXIS_DP),
-        "position_ids": P(AXIS_DP), "labels": P(AXIS_DP),
-    }
+    b_specs = batch_specs(mesh)
+    if mesh.shape[AXIS_SP] > 1:
+        attn_fn = make_sp_attention(pcfg.sequence_parallel, attn_fn)
 
     def local(params, batch):
         labels = batch["labels"]
-        count = jax.lax.psum((labels[:, 1:] != llama.IGNORE_INDEX).sum(), AXIS_DP)
+        sp_size = jax.lax.axis_size(AXIS_SP)
+        count = jax.lax.psum(
+            (_sp_shift_labels(labels, sp_size) != llama.IGNORE_INDEX).sum(),
+            (AXIS_DP, AXIS_SP))
         loss_sum, _ = _pipeline_loss_local(params, batch, cfg, pcfg, attn_fn)
         # (sum, count) so callers can weight across batches exactly — no
         # mean-of-means bias (the defect this module fixes vs the reference)
-        return jax.lax.psum(loss_sum, (AXIS_PP, AXIS_DP)), count
+        return jax.lax.psum(loss_sum, (AXIS_PP, AXIS_DP, AXIS_SP)), count
 
-    return shard_map(local, mesh=mesh, in_specs=(param_specs, batch_specs),
+    return shard_map(local, mesh=mesh, in_specs=(param_specs, b_specs),
                      out_specs=(P(), P()), check_vma=False)
 
 
@@ -576,11 +654,15 @@ def make_pipeline_loss_and_grad(
         raise ValueError(
             f"PipelineConfig.num_stages={pcfg.num_stages} does not match the "
             f"mesh pp axis size {mesh.shape[AXIS_PP]}")
-    if mesh.shape["sp"] != 1:
-        raise ValueError(
-            f"sp>1 is not wired into the pipeline loss yet (mesh sp="
-            f"{mesh.shape['sp']}); use parallel/ring_attention.py standalone")
+    sp = mesh.shape[AXIS_SP]
     tp = mesh.shape[AXIS_TP]
+    if sp > 1 and pcfg.sequence_parallel == "ulysses":
+        local_heads = cfg.num_attention_heads // max(tp, 1)
+        if local_heads % sp:
+            raise ValueError(
+                f"sequence_parallel=ulysses needs heads/tp divisible by sp: "
+                f"{cfg.num_attention_heads}/{tp} = {local_heads} vs sp={sp} "
+                f"(use sequence_parallel=ring, which has no head constraint)")
     if tp > 1:
         if cfg.kv_heads % tp or cfg.num_attention_heads % tp:
             raise ValueError(
@@ -592,16 +674,22 @@ def make_pipeline_loss_and_grad(
             raise ValueError(f"tp={tp} must divide vocab_size={cfg.vocab_size} "
                              f"(vocab-parallel lm_head)")
     param_specs = stage_param_specs(params_like, tp=tp > 1)
-    batch_specs = {
-        "input_ids": P(AXIS_DP), "attention_mask": P(AXIS_DP),
-        "position_ids": P(AXIS_DP), "labels": P(AXIS_DP),
-    }
+    if sp > 1:
+        attn_fn = make_sp_attention(pcfg.sequence_parallel, attn_fn)
 
     fn = shard_map(
         partial(_loss_and_grad_local, cfg=cfg, pcfg=pcfg, attn_fn=attn_fn),
         mesh=mesh,
-        in_specs=(param_specs, batch_specs),
+        in_specs=(param_specs, batch_specs(mesh)),
         out_specs=(P(), param_specs),
         check_vma=False,
     )
     return fn
+
+
+def batch_specs(mesh: Mesh) -> dict:
+    """Batch PartitionSpecs: batch dim over dp, sequence dim over sp (when
+    the mesh has one — every field is per-token [b, L] data, SURVEY.md §3.5)."""
+    spec = P(AXIS_DP, AXIS_SP) if mesh.shape[AXIS_SP] > 1 else P(AXIS_DP)
+    return {"input_ids": spec, "attention_mask": spec,
+            "position_ids": spec, "labels": spec}

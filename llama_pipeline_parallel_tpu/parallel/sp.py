@@ -1,0 +1,68 @@
+"""Sequence-parallel attention routing for the training pipeline.
+
+Bridges the standalone SP strategies (parallel/ring_attention.py,
+parallel/ulysses.py) into the decoder's `AttnFn` slot: inside the pipeline's
+shard_map the sequence dimension of every activation is sharded over the `sp`
+mesh axis, and the wrapped function makes the attention EXACT over the full
+sequence anyway — KV slabs rotate around the ICI ring (ring) or activations
+re-shard head-wise via all-to-all (Ulysses).
+
+The reference has no sequence parallelism at all (SURVEY.md §5.7: sequence
+length fixed at 512, O(L^2) materialized masks — reference conf yaml:32,
+data/flan.py:194-243); this axis is what lets the 16k-context configs
+(BASELINE.md ladder #5) train beyond one chip's attention footprint.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+from llama_pipeline_parallel_tpu.ops.attention import repeat_kv
+from llama_pipeline_parallel_tpu.parallel.mesh import AXIS_SP
+from llama_pipeline_parallel_tpu.parallel.ring_attention import ring_attention
+from llama_pipeline_parallel_tpu.parallel.ulysses import ulysses_attention
+
+SP_STRATEGIES = ("ring", "ulysses")
+
+
+def make_sp_attention(kind: str, inner_attn: Callable,
+                      axis_name: str = AXIS_SP) -> Callable:
+    """Wrap an AttnFn so it computes full-sequence attention over sp shards.
+
+    `inner_attn` is the attention the run would use without sp (exact or the
+    Pallas flash kernel): Ulysses calls it directly on the re-sharded
+    full-sequence view; ring selects its per-slab backend to match
+    (flash kernels when `inner_attn` is the flash path, einsum otherwise).
+    """
+    if kind == "ring":
+        from llama_pipeline_parallel_tpu.ops.flash_attention import flash_attention
+
+        backend = "flash" if inner_attn is flash_attention else "exact"
+
+        def ring_fn(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    padding_mask: Any = None, *, causal: bool = True) -> jnp.ndarray:
+            # Slab rotation needs uniform shapes: expand GQA groups up front.
+            # padding_mask is dropped on purpose — right-padded causal batches
+            # need none (pad rows' losses are IGNORE_INDEX-masked), the same
+            # contract as the flash kernel (ops/flash_attention.py).
+            group = q.shape[2] // k.shape[2]
+            if group > 1:
+                k, v = repeat_kv(k, group), repeat_kv(v, group)
+            return ring_attention(q, k, v, None, causal=causal,
+                                  axis_name=axis_name, backend=backend)
+
+        return ring_fn
+
+    if kind == "ulysses":
+
+        def ulysses_fn(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                       padding_mask: Any = None, *, causal: bool = True) -> jnp.ndarray:
+            return ulysses_attention(q, k, v, padding_mask, causal=causal,
+                                     axis_name=axis_name, inner_attn=inner_attn)
+
+        return ulysses_fn
+
+    raise ValueError(f"unknown sequence_parallel strategy {kind!r}; "
+                     f"choose one of {SP_STRATEGIES}")

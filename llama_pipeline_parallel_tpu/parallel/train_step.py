@@ -27,6 +27,7 @@ from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
 from llama_pipeline_parallel_tpu.parallel.mesh import AXIS_DP, AXIS_PP
 from llama_pipeline_parallel_tpu.parallel.pipeline import (
     PipelineConfig,
+    batch_specs,
     make_pipeline_loss_and_grad,
     stack_stages,
     stage_param_specs,
@@ -178,7 +179,6 @@ def make_train_step(
     loss_grad_fn = make_pipeline_loss_and_grad(
         mesh, cfg, pcfg, params_like, attn_fn=attn_fn or attention)
     shardings = state_shardings(mesh, tx, params_like)
-    batch_sharding = NamedSharding(mesh, P(AXIS_DP))
 
     def step_fn(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
         loss, grads = loss_grad_fn(state.params, batch)
@@ -192,10 +192,8 @@ def make_train_step(
         }
         return TrainState(state.step + 1, new_params, new_opt_state), metrics
 
-    batch_shardings = {
-        "input_ids": batch_sharding, "attention_mask": batch_sharding,
-        "position_ids": batch_sharding, "labels": batch_sharding,
-    }
+    batch_shardings = {k: NamedSharding(mesh, s)
+                       for k, s in batch_specs(mesh).items()}
     return jax.jit(
         step_fn,
         in_shardings=(shardings, batch_shardings),

@@ -148,7 +148,8 @@ def run_training(cfg: dict) -> dict:
         remat=cfg.get("activation_checkpointing", True),
         remat_policy=cfg.get("remat_policy", "nothing_saveable"),
         schedule=cfg.get("pipeline_schedule", "1f1b"),
-        accum_chunks=cfg.get("gradient_accumulation_chunks", 1))
+        accum_chunks=cfg.get("gradient_accumulation_chunks", 1),
+        sequence_parallel=cfg.get("sequence_parallel", "ring"))
 
     dataset, collator = build_dataset_and_collator(cfg, model_cfg)
     micro_batch = cfg.get("per_device_train_batch_size", 1)
@@ -223,6 +224,9 @@ def run_training(cfg: dict) -> dict:
         logger.info("warm-started module weights from %s", cfg["model_name_or_path"])
 
     seq_length = int(collator([dataset[0]])["input_ids"].shape[1])
+    if seq_length % mesh_cfg.sp:
+        raise ValueError(f"sequence length {seq_length} must divide into "
+                         f"sp={mesh_cfg.sp} equal slabs")
     attn_fn = select_attention(cfg.get("attention", "auto"), seq_length, mesh)
     step_fn = ts.make_train_step(mesh, model_cfg, pcfg, tx, schedule,
                                  stacked_template, attn_fn=attn_fn)
