@@ -7,6 +7,16 @@ two must stay in lockstep by convention. Here the mapping is one explicit,
 serializable object that both the pipeline runtime and the checkpoint engine
 consume — which is also what makes PP-topology-changing restores possible
 (SURVEY.md §7.3 item 5).
+
+Uneven partitions (reference `LayerSpec` lists admit them,
+models/llama_ds_mp_wrap.py:209-224; SURVEY.md §7.3 item 2 makes them the
+stage-balance lever): `layer_counts` assigns each stage its own layer count.
+The stacked runtime layout pads every stage to `max_layers_per_stage` slots;
+padded slots hold ZERO weights, which makes the residual decoder block an
+exact identity (all projection outputs vanish) with identically zero
+gradients — a fixed point of AdamW — so correctness never depends on the
+padding being skipped. The pipeline additionally cond-skips padded slots
+when no collective lives inside the layer (parallel/pipeline.py).
 """
 
 from __future__ import annotations
@@ -21,20 +31,57 @@ from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
 class StageManifest:
     num_layers: int
     num_stages: int
+    # None -> even split (num_layers % num_stages must be 0). Otherwise one
+    # count per stage, each >= 1, summing to num_layers.
+    layer_counts: tuple | None = None
 
     def __post_init__(self) -> None:
         if self.num_stages < 1:
             raise ValueError(f"num_stages must be >= 1, got {self.num_stages}")
-        if self.num_layers % self.num_stages:
-            raise ValueError(
-                f"num_layers={self.num_layers} not divisible by "
-                f"num_stages={self.num_stages}; uneven stage partitions are not "
-                f"supported yet (cost-balanced partitioning is a planned knob)"
-            )
+        if self.layer_counts is None:
+            if self.num_layers % self.num_stages:
+                raise ValueError(
+                    f"num_layers={self.num_layers} not divisible by "
+                    f"num_stages={self.num_stages}; pass layer_counts for an "
+                    f"uneven partition (or use StageManifest.balanced)")
+        else:
+            counts = tuple(int(c) for c in self.layer_counts)
+            object.__setattr__(self, "layer_counts", counts)
+            if len(counts) != self.num_stages:
+                raise ValueError(
+                    f"layer_counts has {len(counts)} entries for "
+                    f"num_stages={self.num_stages}")
+            if any(c < 1 for c in counts):
+                raise ValueError(f"every stage needs >= 1 layer, got {counts}")
+            if sum(counts) != self.num_layers:
+                raise ValueError(
+                    f"layer_counts {counts} sum to {sum(counts)}, expected "
+                    f"num_layers={self.num_layers}")
+
+    @property
+    def is_even(self) -> bool:
+        return (self.layer_counts is None
+                or len(set(self.layer_counts)) == 1)
+
+    @property
+    def stage_layer_counts(self) -> tuple:
+        if self.layer_counts is not None:
+            return self.layer_counts
+        return (self.num_layers // self.num_stages,) * self.num_stages
 
     @property
     def layers_per_stage(self) -> int:
-        return self.num_layers // self.num_stages
+        """Uniform per-stage count — only meaningful for even partitions."""
+        if not self.is_even:
+            raise ValueError(
+                f"layers_per_stage is undefined for the uneven partition "
+                f"{self.layer_counts}; use stage_layer_counts/max_layers_per_stage")
+        return self.stage_layer_counts[0]
+
+    @property
+    def max_layers_per_stage(self) -> int:
+        """Slot count of the padded stacked layout [num_stages, k_max, ...]."""
+        return max(self.stage_layer_counts)
 
     # embed lives on the first stage, final norm + lm head on the last
     # (reference layer-list order, models/llama_ds_mp_wrap.py:213-219)
@@ -44,20 +91,73 @@ class StageManifest:
     def head_stage(self) -> int:
         return self.num_stages - 1
 
+    def stage_offsets(self) -> tuple:
+        """Start layer index of each stage (cumulative counts)."""
+        out, acc = [], 0
+        for c in self.stage_layer_counts:
+            out.append(acc)
+            acc += c
+        return tuple(out)
+
     def stage_of_layer(self, layer_idx: int) -> int:
         if not 0 <= layer_idx < self.num_layers:
             raise ValueError(f"layer {layer_idx} out of range [0, {self.num_layers})")
-        return layer_idx // self.layers_per_stage
+        for s, (off, c) in enumerate(zip(self.stage_offsets(),
+                                         self.stage_layer_counts)):
+            if off <= layer_idx < off + c:
+                return s
+        raise AssertionError("unreachable")
 
     def layers_of_stage(self, stage: int) -> range:
         if not 0 <= stage < self.num_stages:
             raise ValueError(f"stage {stage} out of range [0, {self.num_stages})")
-        k = self.layers_per_stage
-        return range(stage * k, (stage + 1) * k)
+        off = self.stage_offsets()[stage]
+        return range(off, off + self.stage_layer_counts[stage])
 
     @staticmethod
     def for_config(cfg: LlamaConfig, num_stages: int) -> "StageManifest":
         return StageManifest(num_layers=cfg.num_hidden_layers, num_stages=num_stages)
+
+    @staticmethod
+    def balanced(cfg: LlamaConfig, num_stages: int,
+                 embed_weight: float | None = None,
+                 head_weight: float | None = None) -> "StageManifest":
+        """Cost-balanced partition: minimize the max per-stage cost, where a
+        stage's cost is its decoder-layer count plus the embed / lm-head
+        weight (in layer units) it hosts.
+
+        Default weights come from the model's matmul flops: one decoder layer
+        moves ~2*(2*d^2 + 2*d*kv + 3*d*f) flops/token forward; the lm-head
+        (and its loss softmax) ~2*d*V; the embedding gather is ~free forward
+        but its backward is a scatter into [V, d], counted like half a head.
+        This is the stage-balance lever SURVEY.md §7.3 item 2 calls the MFU
+        determinant (DeepSpeed's partition_method="parameters" analogue).
+        """
+        d, f, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+        kv_dim = cfg.kv_heads * cfg.head_dim
+        layer_cost = 2 * d * d + 2 * d * kv_dim + 3 * d * f
+        if head_weight is None:
+            head_weight = (d * v) / layer_cost
+        if embed_weight is None:
+            embed_weight = 0.5 * (d * v) / layer_cost
+        n, s = cfg.num_hidden_layers, num_stages
+        if s > n:
+            raise ValueError(f"num_stages={s} exceeds num_layers={n}: every "
+                             f"stage needs at least one decoder layer")
+        if s == 1:
+            return StageManifest(num_layers=n, num_stages=s)
+        extras = [0.0] * s
+        extras[0] += embed_weight
+        extras[-1] += head_weight
+
+        counts = [1] * s
+        for _ in range(n - s):  # greedily grow the currently-cheapest stage
+            j = min(range(s), key=lambda i: (counts[i] + extras[i], i))
+            counts[j] += 1
+        manifest = StageManifest(num_layers=n, num_stages=s,
+                                 layer_counts=tuple(counts))
+        return (StageManifest(num_layers=n, num_stages=s)
+                if manifest.is_even and n % s == 0 else manifest)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))

@@ -155,6 +155,7 @@ def run_layers(
     remat: bool = False,
     tp_axis: str | None = None,
     remat_policy: str = "nothing_saveable",
+    slot_valid: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Apply a stack of layers (leading axis on every leaf) via lax.scan.
 
@@ -164,15 +165,36 @@ def run_layers(
     `remat_policy` trades recompute FLOPs for memory: `nothing_saveable`
     (max memory savings), `dots_saveable` / `dots_with_no_batch_dims_saveable`
     (keep matmul outputs, recompute only elementwise — cheaper backward).
+    `slot_valid` ([num_layers] bool): cond-skip invalid slots — the uneven
+    pipeline partition's zero-weight padding (parallel/pipeline.py). The
+    caller must ONLY pass this when the layer body is collective-free
+    (tp_axis None, no sp attention): a collective inside a branch that other
+    devices skip aborts the runtime.
     """
 
-    def body(h, layer):
+    def compute(layer, h):
         return decoder_layer(layer, h, padding_mask, cos, sin, cfg, attn_fn,
-                             tp_axis=tp_axis), None
+                             tp_axis=tp_axis)
+
+    if slot_valid is None:
+        def body(h, layer):
+            return compute(layer, h), None
+
+        xs = layers
+    else:
+        if tp_axis is not None:
+            raise ValueError("slot_valid cond-skip cannot be combined with "
+                             "tp collectives inside the layer")
+
+        def body(h, xs_):
+            layer, valid = xs_
+            return jax.lax.cond(valid, compute, lambda layer_, h_: h_, layer, h), None
+
+        xs = (layers, slot_valid)
 
     if remat:
         body = jax.checkpoint(body, policy=resolve_remat_policy(remat_policy))
-    x, _ = jax.lax.scan(body, x, layers)
+    x, _ = jax.lax.scan(body, x, xs)
     return x
 
 

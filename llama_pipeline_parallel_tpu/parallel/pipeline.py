@@ -104,6 +104,12 @@ class PipelineConfig:
     # around the ICI ring (parallel/ring_attention.py), "ulysses" re-shards
     # head-wise via all-to-all (parallel/ulysses.py). Ignored at sp=1.
     sequence_parallel: str = "ring"
+    # Per-stage decoder-layer counts for UNEVEN partitions (from
+    # StageManifest.stage_layer_counts). None -> even split. Used to cond-skip
+    # the zero-weight padding slots of the stacked layout when the decoder
+    # layer is collective-free (tp=1, sp=1); with collectives inside, padded
+    # slots still compute (they are exact identities either way).
+    layer_counts: tuple | None = None
 
     def __post_init__(self) -> None:
         from llama_pipeline_parallel_tpu.parallel.sp import SP_STRATEGIES
@@ -122,6 +128,13 @@ class PipelineConfig:
             raise ValueError(
                 f"accum_chunks={self.accum_chunks} must divide "
                 f"num_microbatches={self.num_microbatches}")
+        if self.layer_counts is not None:
+            object.__setattr__(self, "layer_counts",
+                               tuple(int(c) for c in self.layer_counts))
+            if len(self.layer_counts) != self.num_stages:
+                raise ValueError(
+                    f"layer_counts has {len(self.layer_counts)} entries for "
+                    f"num_stages={self.num_stages}")
         llama.resolve_remat_policy(self.remat_policy)  # fail fast on typos
 
 
@@ -137,21 +150,65 @@ def _reshape_leaf(x, shape: tuple[int, ...]):
 
 
 def stack_stages(params: Params, manifest: StageManifest) -> Params:
-    """Reshape stacked layer leaves to expose the stage axis for pp sharding."""
-    s, k = manifest.num_stages, manifest.layers_per_stage
+    """Canonical [n_layers, ...] -> stacked [num_stages, k_max, ...] leaves,
+    exposing the stage axis for pp sharding.
+
+    Even partitions are a pure reshape. Uneven partitions gather each stage's
+    layers into its first `layer_counts[s]` slots and ZERO the padding slots —
+    an all-zero residual block is an exact identity with identically zero
+    gradients (see manifest.py), so the padded layout is correct by
+    construction."""
+    s, k = manifest.num_stages, manifest.max_layers_per_stage
+    if manifest.is_even:
+        out = dict(params)
+        out["layers"] = jax.tree.map(
+            lambda x: _reshape_leaf(x, (s, k) + tuple(x.shape[1:])), params["layers"])
+        return out
+
+    import numpy as np
+
+    idx = np.zeros((s, k), np.int32)
+    mask = np.zeros((s, k), bool)
+    for st in range(s):
+        for j, layer in enumerate(manifest.layers_of_stage(st)):
+            idx[st, j], mask[st, j] = layer, True
+
+    def stack_leaf(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return jax.ShapeDtypeStruct((s, k) + tuple(x.shape[1:]), x.dtype)
+        g = jnp.asarray(x)[idx]  # [s, k, ...]
+        m = mask.reshape((s, k) + (1,) * (g.ndim - 2))
+        return jnp.where(m, g, jnp.zeros((), g.dtype))
 
     out = dict(params)
-    out["layers"] = jax.tree.map(
-        lambda x: _reshape_leaf(x, (s, k) + tuple(x.shape[1:])), params["layers"])
+    out["layers"] = jax.tree.map(stack_leaf, params["layers"])
     return out
 
 
 def unstack_stages(params: Params, manifest: StageManifest) -> Params:
     n = manifest.num_layers
+    s, k = manifest.num_stages, manifest.max_layers_per_stage
+    if manifest.is_even:
+        out = dict(params)
+        out["layers"] = jax.tree.map(
+            lambda x: _reshape_leaf(x, (n,) + tuple(x.shape[2:])), params["layers"])
+        return out
+
+    import numpy as np
+
+    flat_idx = np.zeros((n,), np.int32)
+    for st in range(s):
+        for j, layer in enumerate(manifest.layers_of_stage(st)):
+            flat_idx[layer] = st * k + j
+
+    def unstack_leaf(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return jax.ShapeDtypeStruct((n,) + tuple(x.shape[2:]), x.dtype)
+        flat = jnp.asarray(x).reshape((s * k,) + tuple(x.shape[2:]))
+        return flat[flat_idx]
 
     out = dict(params)
-    out["layers"] = jax.tree.map(
-        lambda x: _reshape_leaf(x, (n,) + tuple(x.shape[2:])), params["layers"])
+    out["layers"] = jax.tree.map(unstack_leaf, params["layers"])
     return out
 
 
@@ -242,6 +299,18 @@ def _vocab_parallel_token_loss(params: Params, h: jnp.ndarray, labels: jnp.ndarr
 # The schedule
 # ---------------------------------------------------------------------------
 
+def _slot_valid(pcfg: PipelineConfig, stage, tp_size: int, sp_size: int,
+                k_max: int):
+    """[k_max] bool mask of REAL layer slots for this stage under an uneven
+    partition, or None when all slots are real — or when the layer body
+    contains collectives (tp/sp > 1), where cond-skipping is unsafe and the
+    zero-weight padding computes as an exact identity instead."""
+    if (pcfg.layer_counts is None or len(set(pcfg.layer_counts)) == 1
+            or tp_size > 1 or sp_size > 1):
+        return None
+    counts = jnp.asarray(pcfg.layer_counts, jnp.int32)
+    return jnp.arange(k_max) < counts[stage]
+
 def _pipeline_loss_local(
     params: Params,
     batch: Batch,
@@ -323,9 +392,12 @@ def _pipeline_loss_local(
         cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta, dtype=cfg.dtype)
 
         tp_axis = AXIS_TP if tp_size > 1 else None
+        k_max = jax.tree.leaves(local_layers)[0].shape[0]
         y = llama.run_layers(local_layers, x_in, pad_mask, cos, sin, cfg,
                              attn_fn=attn_fn, remat=pcfg.remat, tp_axis=tp_axis,
-                             remat_policy=pcfg.remat_policy)
+                             remat_policy=pcfg.remat_policy,
+                             slot_valid=_slot_valid(pcfg, stage, tp_size,
+                                                    sp_size, k_max))
 
         # The last stage's finished microbatch contributes its loss in-tick
         # (nothing is collected into an M-sized buffer; lm-head cost per tick
@@ -450,9 +522,12 @@ def _pipeline_1f1b_local(
             lambda emb, x: x,
             p["embed"], x_in)
         local_layers = jax.tree.map(lambda a: a[0], p["layers"])
+        k_max = jax.tree.leaves(local_layers)[0].shape[0]
         y = llama.run_layers(local_layers, x0, pad, cos, sin, cfg, attn_fn=attn_fn,
                              remat=pcfg.remat, tp_axis=tp_axis,
-                             remat_policy=pcfg.remat_policy)
+                             remat_policy=pcfg.remat_policy,
+                             slot_valid=_slot_valid(pcfg, stage, tp_size,
+                                                    sp_size, k_max))
         if not with_loss:
             return y
 
@@ -656,6 +731,19 @@ def make_pipeline_loss_and_grad(
             f"mesh pp axis size {mesh.shape[AXIS_PP]}")
     sp = mesh.shape[AXIS_SP]
     tp = mesh.shape[AXIS_TP]
+    if pcfg.layer_counts is not None:
+        k_max = jax.tree.leaves(params_like["layers"])[0].shape[1]
+        if sum(pcfg.layer_counts) != cfg.num_hidden_layers:
+            raise ValueError(
+                f"layer_counts {pcfg.layer_counts} sum to "
+                f"{sum(pcfg.layer_counts)} but the model has "
+                f"{cfg.num_hidden_layers} layers")
+        if max(pcfg.layer_counts) != k_max:
+            raise ValueError(
+                f"layer_counts {pcfg.layer_counts} (max "
+                f"{max(pcfg.layer_counts)}) do not match the stacked params' "
+                f"{k_max} slots per stage — stack_stages used a different "
+                f"manifest")
     if sp > 1 and pcfg.sequence_parallel == "ulysses":
         local_heads = cfg.num_attention_heads // max(tp, 1)
         if local_heads % sp:

@@ -105,14 +105,17 @@ def build_dataset_and_collator(cfg: dict, model_cfg: LlamaConfig) -> tuple[Any, 
     return ds, collator
 
 
-def select_attention(impl: str, seq_length: int, mesh) -> Any:
+def select_attention(impl: str, seq_length: int, mesh,
+                     sequence_parallel: str = "ring") -> Any:
     """'exact' | 'flash' | 'auto'. The reference tried and failed to enable
     flash attention (README.md:141-143); here it is the default for long
     sequences on TPU, where the exact path's O(L^2) scores dominate.
 
     `seq_length` must be the ACTUAL batch sequence length (probe the
     collator), not a config guess. `auto` falls back to the exact path when
-    the length does not tile into the flash kernel's blocks."""
+    the length the kernel actually sees does not tile into flash blocks —
+    under ring sequence parallelism that is the PER-SLAB length seq/sp
+    (Ulysses re-shards to the full sequence, so there it stays seq)."""
     from llama_pipeline_parallel_tpu.ops.attention import attention
     from llama_pipeline_parallel_tpu.ops.flash_attention import flash_attention
 
@@ -121,14 +124,17 @@ def select_attention(impl: str, seq_length: int, mesh) -> Any:
     if impl == "flash":
         return flash_attention
     if impl == "auto":
+        sp = mesh.shape["sp"]
+        kernel_len = seq_length // sp if (sp > 1 and sequence_parallel == "ring") \
+            else seq_length
         on_tpu = mesh.devices.ravel()[0].platform == "tpu"
-        tiles = seq_length % 1024 == 0  # must divide the flash block size
-        if on_tpu and seq_length >= 2048 and not tiles:
+        tiles = kernel_len % 1024 == 0  # must divide the flash block size
+        if on_tpu and kernel_len >= 2048 and not tiles:
             logger.warning(
-                "attention=auto: seq_length=%d does not tile into flash blocks; "
-                "using the exact path (pad to a 1024 multiple to enable flash)",
-                seq_length)
-        return flash_attention if (on_tpu and seq_length >= 2048 and tiles) else attention
+                "attention=auto: kernel sequence length %d (seq %d / sp slab) "
+                "does not tile into flash blocks; using the exact path (pad to "
+                "a 1024 multiple to enable flash)", kernel_len, seq_length)
+        return flash_attention if (on_tpu and kernel_len >= 2048 and tiles) else attention
     raise ValueError(f"unknown attention impl {impl!r} (use exact|flash|auto)")
 
 
@@ -141,7 +147,20 @@ def run_training(cfg: dict) -> dict:
     mesh_cfg = MeshConfig(**cfg.get("mesh", {}))
     mesh = make_mesh(mesh_cfg)
     model_cfg = build_model_config(cfg["model"])
-    manifest = StageManifest.for_config(model_cfg, mesh_cfg.pp)
+    # Stage partition: explicit per-stage layer_counts > cost-balanced
+    # (`stage_balance: cost`, the SURVEY §7.3-item-2 MFU lever) > even split.
+    # Indivisible layer counts fall back to cost-balanced automatically.
+    if cfg.get("layer_counts"):
+        manifest = StageManifest(num_layers=model_cfg.num_hidden_layers,
+                                 num_stages=mesh_cfg.pp,
+                                 layer_counts=tuple(cfg["layer_counts"]))
+    elif (cfg.get("stage_balance", "even") == "cost"
+          or model_cfg.num_hidden_layers % mesh_cfg.pp):
+        manifest = StageManifest.balanced(model_cfg, mesh_cfg.pp)
+        logger.info("stage partition (cost-balanced): %s",
+                    manifest.stage_layer_counts)
+    else:
+        manifest = StageManifest.for_config(model_cfg, mesh_cfg.pp)
     pcfg = pl.PipelineConfig(
         num_stages=mesh_cfg.pp,
         num_microbatches=cfg.get("gradient_accumulation_steps", 1),
@@ -149,7 +168,8 @@ def run_training(cfg: dict) -> dict:
         remat_policy=cfg.get("remat_policy", "nothing_saveable"),
         schedule=cfg.get("pipeline_schedule", "1f1b"),
         accum_chunks=cfg.get("gradient_accumulation_chunks", 1),
-        sequence_parallel=cfg.get("sequence_parallel", "ring"))
+        sequence_parallel=cfg.get("sequence_parallel", "ring"),
+        layer_counts=None if manifest.is_even else manifest.stage_layer_counts)
 
     dataset, collator = build_dataset_and_collator(cfg, model_cfg)
     micro_batch = cfg.get("per_device_train_batch_size", 1)
@@ -227,7 +247,8 @@ def run_training(cfg: dict) -> dict:
     if seq_length % mesh_cfg.sp:
         raise ValueError(f"sequence length {seq_length} must divide into "
                          f"sp={mesh_cfg.sp} equal slabs")
-    attn_fn = select_attention(cfg.get("attention", "auto"), seq_length, mesh)
+    attn_fn = select_attention(cfg.get("attention", "auto"), seq_length, mesh,
+                               sequence_parallel=cfg.get("sequence_parallel", "ring"))
     step_fn = ts.make_train_step(mesh, model_cfg, pcfg, tx, schedule,
                                  stacked_template, attn_fn=attn_fn)
 
@@ -492,7 +513,8 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
                         out_shardings=shardings)
 
     seq_length = int(collator([dataset[0]])["input_ids"].shape[1])
-    attn_fn = select_attention(cfg.get("attention", "auto"), seq_length, mesh)
+    attn_fn = select_attention(cfg.get("attention", "auto"), seq_length, mesh,
+                               sequence_parallel=cfg.get("sequence_parallel", "ring"))
     grad_fn = jax.jit(pl.make_pipeline_loss_and_grad(
         mesh, model_cfg, pcfg, stacked_template, attn_fn=attn_fn))
 

@@ -63,7 +63,7 @@ def test_sp_in_pipeline_matches_reference(cfg, params, devices, pp, dp, sp, stra
     The batch has trailing padding and prompt masking, so the cross-shard
     label shift (the target of the slab boundary token lives on the next sp
     rank) and the IGNORE_INDEX bookkeeping are both exercised."""
-    batch = make_batch(cfg, batch_size=max(2 * dp, dp * 2), seqlen=16)
+    batch = make_batch(cfg, batch_size=2 * dp, seqlen=16)  # 2 microbatch rows per dp shard
     ref_loss, ref_grads = reference_loss_and_grad(params, batch, cfg)
     loss, grads = run_sp_pipeline(params, batch, cfg, pp=pp, dp=dp, sp=sp,
                                   microbatches=2, sequence_parallel=strategy)

@@ -1,0 +1,148 @@
+"""Uneven / cost-balanced stage partitions (SURVEY.md §7.3 item 2; the
+reference's LayerSpec lists admit uneven cuts, models/llama_ds_mp_wrap.py:209).
+
+The stacked runtime layout pads stages to max_layers_per_stage with all-zero
+layers (exact identities with zero gradients); these tests pin that the
+padding is invisible to the math — grads match single-device — and that the
+checkpoint layout stays canonical across partition changes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llama_pipeline_parallel_tpu.models.llama import model as llama
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
+
+from tests.test_pipeline import (
+    assert_tree_close,
+    make_batch,
+    reference_loss_and_grad,
+)
+
+
+def run_uneven(params, batch, cfg, pp, counts, microbatches=4, schedule="1f1b",
+               dp=1, tp=1):
+    mesh = make_mesh(MeshConfig(pp=pp, dp=dp, tp=tp))
+    manifest = StageManifest(num_layers=cfg.num_hidden_layers, num_stages=pp,
+                             layer_counts=tuple(counts))
+    stacked = pl.stack_stages(params, manifest)
+    pcfg = pl.PipelineConfig(num_stages=pp, num_microbatches=microbatches,
+                             schedule=schedule,
+                             layer_counts=manifest.stage_layer_counts)
+    fn = jax.jit(pl.make_pipeline_loss_and_grad(mesh, cfg, pcfg, stacked))
+    loss, grads = fn(stacked, batch)
+    return loss, pl.unstack_stages(grads, manifest), manifest
+
+
+def test_13_layers_on_4_stages_matches_single_device(devices):
+    """The VERDICT acceptance case: 13 layers, 4 stages, grad parity."""
+    cfg = LlamaConfig.tiny(num_hidden_layers=13)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    batch = make_batch(cfg)
+    ref_loss, ref_grads = reference_loss_and_grad(params, batch, cfg)
+    loss, grads, _ = run_uneven(params, batch, cfg, pp=4, counts=(4, 4, 4, 1))
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    assert_tree_close(grads, ref_grads)
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+def test_uneven_both_schedules(devices, schedule):
+    cfg = LlamaConfig.tiny(num_hidden_layers=6)
+    params = llama.init_params(jax.random.PRNGKey(1), cfg)
+    batch = make_batch(cfg)
+    ref_loss, ref_grads = reference_loss_and_grad(params, batch, cfg)
+    loss, grads, _ = run_uneven(params, batch, cfg, pp=4, counts=(2, 2, 1, 1),
+                                schedule=schedule)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    assert_tree_close(grads, ref_grads)
+
+
+def test_uneven_with_tp_identity_padding(devices):
+    """tp>1 forbids cond-skipping, so the padded slots COMPUTE — the all-zero
+    layer must still behave as an exact identity under tp collectives."""
+    cfg = LlamaConfig.tiny(num_hidden_layers=3)
+    params = llama.init_params(jax.random.PRNGKey(2), cfg)
+    batch = make_batch(cfg, batch_size=4)
+    ref_loss, ref_grads = reference_loss_and_grad(params, batch, cfg)
+    loss, grads, _ = run_uneven(params, batch, cfg, pp=2, counts=(2, 1),
+                                microbatches=2, tp=2)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+    assert_tree_close(grads, ref_grads, rtol=5e-5, atol=2e-6)
+
+
+def test_padded_slot_grads_are_zero(devices):
+    """Padding slots must be AdamW fixed points: exactly zero gradient."""
+    cfg = LlamaConfig.tiny(num_hidden_layers=3)
+    params = llama.init_params(jax.random.PRNGKey(3), cfg)
+    batch = make_batch(cfg, batch_size=4)
+    mesh = make_mesh(MeshConfig(pp=2))
+    manifest = StageManifest(num_layers=3, num_stages=2, layer_counts=(2, 1))
+    stacked = pl.stack_stages(params, manifest)
+    pcfg = pl.PipelineConfig(num_stages=2, num_microbatches=2,
+                             layer_counts=(2, 1))
+    fn = jax.jit(pl.make_pipeline_loss_and_grad(mesh, cfg, pcfg, stacked))
+    _, grads = fn(stacked, batch)
+    # stage 1's slot 1 is padding: stacked leaf [2, 2, ...] index [1, 1]
+    for leaf in jax.tree.leaves(grads["layers"]):
+        np.testing.assert_array_equal(np.asarray(leaf)[1, 1], 0.0)
+
+
+def test_ckpt_restore_across_partition_change(devices, tmp_path):
+    """Save under an uneven PP=4 partition, restore into an even PP=2 one:
+    the canonical checkpoint layout is partition-agnostic (the reference's
+    filename arithmetic forbids exactly this, SURVEY.md §7.3 item 5)."""
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=6)
+    params = llama.init_params(jax.random.PRNGKey(4), cfg)
+    uneven = StageManifest(num_layers=6, num_stages=4, layer_counts=(2, 2, 1, 1))
+    stacked_uneven = pl.stack_stages(params, uneven)
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(7, stacked_uneven, uneven, cfg)
+
+    even = StageManifest(num_layers=6, num_stages=2)
+    template = pl.stack_stages(
+        jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params),
+        even)
+    restored = mgr.load_params(7, template, even)
+    assert_tree_close(pl.unstack_stages(restored, even), params, rtol=0, atol=0)
+
+
+def test_balanced_factory_properties():
+    """Cost balancing: valid cover, head stage never takes the remainder, and
+    a genuinely heavy lm-head (vocab >> hidden) sheds decoder layers."""
+    # 65B at PP=8: the lm-head is only ~0.3 layer-equivalents, so the even
+    # 10x8 split IS the balanced one — balancing must not force unevenness.
+    assert StageManifest.balanced(LlamaConfig.llama_65b(), 8).is_even
+    # indivisible count: the remainder lands on the cheapest stages
+    cfg = LlamaConfig.tiny(num_hidden_layers=13)
+    man = StageManifest.balanced(cfg, 4)
+    counts = man.stage_layer_counts
+    assert sum(counts) == 13 and len(counts) == 4 and min(counts) >= 1
+    assert counts[-1] == min(counts)  # head stage is the lightest
+    # stage_of_layer / layers_of_stage stay mutually consistent
+    for layer in range(13):
+        assert layer in man.layers_of_stage(man.stage_of_layer(layer))
+    # heavy head (vocab 4096 at hidden 64 ~= 7 layer-equivalents): the head
+    # stage ends up strictly lighter than the middle stages
+    heavy = LlamaConfig.tiny(num_hidden_layers=8, vocab_size=4096)
+    c2 = StageManifest.balanced(heavy, 4).stage_layer_counts
+    assert sum(c2) == 8 and c2[-1] < max(c2)
+
+
+def test_manifest_validation():
+    with pytest.raises(ValueError, match="sum to"):
+        StageManifest(num_layers=8, num_stages=2, layer_counts=(3, 3))
+    with pytest.raises(ValueError, match=">= 1 layer"):
+        StageManifest(num_layers=4, num_stages=2, layer_counts=(4, 0))
+    with pytest.raises(ValueError, match="not divisible"):
+        StageManifest(num_layers=7, num_stages=2)
+    # round-trips through JSON with counts intact
+    man = StageManifest(num_layers=7, num_stages=2, layer_counts=(4, 3))
+    assert StageManifest.from_json(man.to_json()) == man
