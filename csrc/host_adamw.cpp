@@ -4,9 +4,10 @@
 // reference's `offload_optimizer: device: cpu` config, reference conf
 // yaml:160-162): fp32 master params + moments live in host DRAM; the device
 // only ever holds the bf16 working copy. The kernel is a single fused pass
-// (one read of g, one read/write of p/m/v each) — memory-bandwidth-bound, so
-// the scalar loop below autovectorizes (-O3 -march=native) to the same
-// throughput as hand-written AVX while staying portable.
+// (one read of g, one read/write of p/m/v each) — memory-bandwidth-bound —
+// parallelized across cores (`omp parallel for`) and vectorized within each
+// (`simd`), like DeepSpeedCPUAdam's AVX+OpenMP loop. Thread count follows
+// OMP_NUM_THREADS.
 //
 // Bias correction matches optax.adamw's `scale_by_adam` (mhat = m/(1-b1^t))
 // so the offloaded path is numerically interchangeable with the on-device
@@ -32,7 +33,7 @@ void adamw_step(float* __restrict p,
   const float one_m_b1 = 1.0f - b1;
   const float one_m_b2 = 1.0f - b2;
 
-#pragma omp simd
+#pragma omp parallel for simd schedule(static)
   for (int64_t i = 0; i < n; ++i) {
     const float gi = g[i] * grad_scale;
     const float mi = b1 * m[i] + one_m_b1 * gi;
@@ -48,19 +49,19 @@ void adamw_step(float* __restrict p,
 // Squared L2 norm of a buffer (for host-side global-norm clipping).
 double l2_norm_sq(const float* __restrict g, int64_t n) {
   double acc = 0.0;
-#pragma omp simd reduction(+ : acc)
+#pragma omp parallel for simd reduction(+ : acc) schedule(static)
   for (int64_t i = 0; i < n; ++i) {
     acc += static_cast<double>(g[i]) * static_cast<double>(g[i]);
   }
   return acc;
 }
 
-// fp32 -> bf16 (round-to-nearest-even) for building the device working copy
-// without an extra fp32 H2D transfer.
+// fp32 -> bf16 (round-to-nearest-even): builds the device working copy on
+// the host so the H2D transfer moves HALF the bytes of an fp32 upload.
 void f32_to_bf16(const float* __restrict src, uint16_t* __restrict dst,
                  int64_t n) {
   const uint32_t* bits = reinterpret_cast<const uint32_t*>(src);
-#pragma omp simd
+#pragma omp parallel for simd schedule(static)
   for (int64_t i = 0; i < n; ++i) {
     uint32_t x = bits[i];
     uint32_t rounding = 0x7FFFu + ((x >> 16) & 1u);

@@ -119,6 +119,34 @@ class CheckpointManager:
             self._ckptr.save(os.path.join(path, "opt"),
                              _canonicalize_moments(opt_state, manifest, to_canonical=True),
                              force=True)
+        self._commit(path, step, manifest, cfg,
+                     has_optimizer_state=opt_state is not None)
+        return path
+
+    def save_offload(self, step: int, host, manifest: StageManifest,
+                     cfg: LlamaConfig) -> str:
+        """Streamed save for the host-offloaded optimizer: params, then m,
+        then v, each assembled-and-written before the next is assembled —
+        extra device HBM is bounded at ONE fp32 tree instead of three (at
+        65B the difference between fitting and OOMing: the whole point of
+        offload is that p+m+v do NOT fit on device together)."""
+        path = self.step_dir(step)
+        self._ckptr.save(os.path.join(path, "params"),
+                         pl.unstack_stages(host.masters_tree(), manifest),
+                         force=True)
+        self._ckptr.wait_until_finished()
+        for attr in ("m", "v"):
+            self._ckptr.save(os.path.join(path, f"opt_{attr}"),
+                             pl.unstack_stages(host.moments_tree(attr), manifest),
+                             force=True)
+            self._ckptr.wait_until_finished()
+        self._commit(path, step, manifest, cfg, has_optimizer_state=True,
+                     opt_layout="offload_parts",
+                     opt_step_count=int(host.step_count))
+        return path
+
+    def _commit(self, path: str, step: int, manifest: StageManifest,
+                cfg: LlamaConfig, **meta_extra) -> None:
         # StandardCheckpointer writes asynchronously; the tag/meta below must
         # only appear once the array data is durably on disk — on EVERY
         # process, not just this one. Barrier first, then let a single
@@ -133,8 +161,8 @@ class CheckpointManager:
                 "step": step,
                 "manifest": dataclasses.asdict(manifest),
                 "model_config": _config_meta(cfg),
-                "has_optimizer_state": opt_state is not None,
                 "format_version": 1,
+                **meta_extra,
             }
             with open(os.path.join(path, "meta.json"), "w") as f:
                 json.dump(meta, f, indent=2)
@@ -142,7 +170,6 @@ class CheckpointManager:
                 f.write(f"checkpoint-{step}")
         dist.barrier(f"ckpt-commit-{step}")
         logger.info("saved checkpoint-%d to %s", step, path)
-        return path
 
     # -- load -------------------------------------------------------------
 
@@ -160,6 +187,24 @@ class CheckpointManager:
             os.path.join(self.step_dir(step), "params"), _abstract(canonical))
         return pl.stack_stages(restored, manifest)
 
+    def load_offload_moments(self, step: int, params_template_stacked: dict,
+                             manifest: StageManifest) -> tuple[dict, dict, int]:
+        """Restore the offload layout's moment trees (m, v, step_count),
+        one item at a time (same HBM bounding as save_offload)."""
+        meta = self.load_meta(step)
+        if meta.get("opt_layout") != "offload_parts":
+            raise ValueError(
+                f"checkpoint-{step} was not written by the offloaded "
+                f"optimizer (opt_layout={meta.get('opt_layout')!r})")
+        canonical = pl.unstack_stages(params_template_stacked, manifest)
+        out = []
+        for attr in ("m", "v"):
+            restored = self._ckptr.restore(
+                os.path.join(self.step_dir(step), f"opt_{attr}"),
+                _abstract(canonical))
+            out.append(pl.stack_stages(restored, manifest))
+        return out[0], out[1], int(meta["opt_step_count"])
+
     def load(self, step: int, params_template_stacked: dict, opt_template: Any,
              manifest: StageManifest) -> tuple[dict, Any, int]:
         """Full-state resume (reference trainer_base_ds_mp.py:297-299)."""
@@ -168,6 +213,12 @@ class CheckpointManager:
             raise ValueError(
                 f"checkpoint-{step} has no optimizer state (module-only / "
                 f"converter output); use load_params for a warm start")
+        if meta.get("opt_layout") == "offload_parts":
+            raise ValueError(
+                f"checkpoint-{step} was written by the host-offloaded "
+                f"optimizer (opt_layout=offload_parts); resume it with "
+                f"optimizer_offload: true, or warm-start module-only via "
+                f"model_name_or_path")
         params = self.load_params(step, params_template_stacked, manifest)
         opt_canonical = _canonicalize_moments(opt_template, manifest, to_canonical=True)
         restored_opt = self._ckptr.restore(

@@ -9,9 +9,25 @@ bf16 params H2D. Unlike the reference, bf16 compute works WITH offload —
 there is no fp16 loss-scale state machine to conflict with it (reference
 README.md:133-139 documents that incompatibility).
 
-The update kernel is C++ (csrc/host_adamw.cpp), compiled on first use with
-the system g++ and bound via ctypes — no pybind11 dependency. A pure-numpy
-fallback keeps the path alive where no compiler exists.
+Sharding-aware, multi-host capable: masters/moments are stored PER DEVICE
+SHARD, mirroring the param arrays' mesh sharding — each process keeps and
+updates only the shards its addressable devices hold (a 65B pp=8 run spreads
+the ~780 GB of optimizer state across hosts the way the reference's ZeRO-1
+offload spreads it across ranks). The global grad norm deduplicates
+replicated shards by min-device ownership and sums across processes with one
+tiny host allgather. Checkpoint state is assembled into globally-sharded
+jax.Arrays, so Orbax writes each host's shards from that host.
+
+Step-time hygiene: grad D2H transfers for ALL shards are started
+asynchronously up front and overlap the per-shard kernel work; the device
+working copy is cast fp32->bf16 on the HOST (native round-to-nearest-even
+kernel), halving H2D bytes vs uploading fp32 and casting on device. Per-phase
+timings are kept in `last_timings`.
+
+The update kernel is C++ (csrc/host_adamw.cpp, OpenMP parallel + SIMD),
+compiled on first use with the system g++ and bound via ctypes — no pybind11
+dependency. A pure-numpy fallback keeps the path alive where no compiler
+exists.
 """
 
 from __future__ import annotations
@@ -21,6 +37,7 @@ import dataclasses
 import os
 import subprocess
 import tempfile
+import time
 from typing import Any
 
 import numpy as np
@@ -48,7 +65,7 @@ def _load_native():
         src = os.path.abspath(_CSRC)
         if (not os.path.exists(so_path)
                 or os.path.getmtime(so_path) < os.path.getmtime(src)):
-            cmd = ["g++", "-O3", "-march=native", "-fopenmp-simd", "-shared",
+            cmd = ["g++", "-O3", "-march=native", "-fopenmp", "-shared",
                    "-fPIC", src, "-o", so_path]
             subprocess.run(cmd, check=True, capture_output=True)
             logger.info("compiled host AdamW kernel -> %s", so_path)
@@ -59,6 +76,8 @@ def _load_native():
             ctypes.c_float, ctypes.c_int64, ctypes.c_float]
         lib.l2_norm_sq.restype = ctypes.c_double
         lib.l2_norm_sq.argtypes = [ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+        lib.f32_to_bf16.argtypes = [ctypes.POINTER(ctypes.c_float),
+                                    ctypes.POINTER(ctypes.c_uint16), ctypes.c_int64]
         _lib = lib
     except Exception as e:
         logger.warning("native host AdamW unavailable (%r); using numpy fallback", e)
@@ -81,13 +100,118 @@ def _adamw_numpy(p, m, v, g, lr, b1, b2, eps, wd, step, grad_scale):
     p -= lr * (mhat / (np.sqrt(vhat) + eps) + wd * p)
 
 
+def _cast_bf16(src: np.ndarray, native) -> np.ndarray:
+    """fp32 -> bf16 numpy array (native RNE kernel, ml_dtypes fallback)."""
+    import ml_dtypes
+
+    if native is not None:
+        out = np.empty(src.shape, np.uint16)
+        native.f32_to_bf16(_fptr(src), out.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_uint16)), src.size)
+        return out.view(ml_dtypes.bfloat16)
+    return src.astype(ml_dtypes.bfloat16)
+
+
+def _index_key(index: tuple) -> tuple:
+    return tuple((s.start, s.stop, s.step) for s in index)
+
+
+@dataclasses.dataclass
+class _Shard:
+    """One distinct shard of one param leaf, process-local."""
+
+    index: tuple          # tuple of slices into the global array
+    devices: list         # addressable devices holding this shard
+    owner: bool           # does THIS process own it for global-norm counting?
+    p: np.ndarray         # fp32 master
+    m: np.ndarray
+    v: np.ndarray
+
+
+class _Leaf:
+    """All process-local shards of one param leaf + its global layout."""
+
+    def __init__(self, x) -> None:
+        import jax
+
+        self.global_shape = tuple(x.shape)
+        self.sharding = x.sharding
+        imap = self.sharding.devices_indices_map(self.global_shape)
+        by_key: dict = {}
+        for d, index in imap.items():
+            by_key.setdefault(_index_key(index), []).append(d)
+        local_data = {_index_key(s.index): s.data for s in x.addressable_shards}
+        pid = jax.process_index()
+        self.shards: dict = {}
+        for key, devs in by_key.items():
+            local_devs = [d for d in devs if d.process_index == pid]
+            if not local_devs:
+                continue
+            owner_dev = min(devs, key=lambda d: d.id)
+            self.shards[key] = _Shard(
+                index=tuple(slice(*k) for k in key),
+                devices=local_devs,
+                owner=owner_dev.process_index == pid,
+                p=np.array(local_data[key], np.float32, copy=True, order="C"),
+                m=np.zeros(local_data[key].shape, np.float32),
+                v=np.zeros(local_data[key].shape, np.float32),
+            )
+        if not self.shards:
+            raise ValueError("process holds no shard of a param leaf — the "
+                             "mesh leaves this host without addressable devices")
+
+    def grad_shards(self, g) -> dict:
+        """key -> host fp32 grad array for each of this leaf's shard keys.
+        Falls back to slicing a full transfer when the grad array's sharding
+        does not match the masters' (it does on the trainer path)."""
+        avail = {_index_key(s.index): s.data for s in g.addressable_shards}
+        out, full = {}, None
+        for key, shard in self.shards.items():
+            if key in avail:
+                out[key] = avail[key]
+            else:
+                if full is None:
+                    full = np.asarray(g, np.float32)
+                out[key] = np.ascontiguousarray(full[shard.index])
+        return out
+
+    def assemble(self, values: dict) -> Any:
+        """Build the globally-sharded jax.Array for this leaf from per-key
+        host arrays (this process contributes its addressable shards).
+
+        Fully-replicated leaves (one distinct shard) go through
+        `jax.device_put(value, sharding)`, which lets the runtime upload once
+        and broadcast. Sharded leaves use per-device puts; when a shard is
+        replicated across dp those bytes upload once per local replica — an
+        ICI-broadcast optimization left for when multi-chip H2D shows up in
+        a profile (single-chip, the bench path, has no replicas)."""
+        import jax
+
+        if len(self.shards) == 1:
+            (shard,) = self.shards.values()
+            covers_all = all(
+                (sl.start in (0, None)) and (sl.stop in (dim, None))
+                for sl, dim in zip(shard.index, self.global_shape))
+            if covers_all:
+                return jax.device_put(values[_index_key(shard.index)],
+                                      self.sharding)
+        arrays = []
+        for shard in self.shards.values():
+            key = _index_key(shard.index)
+            for d in shard.devices:
+                arrays.append(jax.device_put(values[key], d))
+        return jax.make_array_from_single_device_arrays(
+            self.global_shape, self.sharding, arrays)
+
+
 @dataclasses.dataclass
 class HostOffloadAdamW:
-    """AdamW with fp32 masters + moments in host DRAM.
+    """AdamW with fp32 masters + moments in host DRAM, sharding-aware.
 
-    Drives flat fp32 numpy buffers; integrates with jax via
-    `update(grad_tree) -> param_tree(bf16-ready)`. Contract mirrors
-    optax.adamw(chain clip_by_global_norm) numerics.
+    Contract mirrors optax.adamw (chained with clip_by_global_norm) numerics.
+    `update(grad_tree)` steps the masters; `device_params(dtype)` builds the
+    bf16 working copy; `masters_tree()`/`state_dict()` expose globally
+    sharded fp32 arrays for checkpointing.
     """
 
     cfg: OptimizerConfig
@@ -96,80 +220,159 @@ class HostOffloadAdamW:
         import jax
 
         leaves, self._treedef = jax.tree_util.tree_flatten(params_tree)
-        self._shapes = [np.shape(x) for x in leaves]
-        self._params = [np.array(x, np.float32, copy=True, order="C") for x in leaves]
-        self._m = [np.zeros_like(p) for p in self._params]
-        self._v = [np.zeros_like(p) for p in self._params]
+        self._leaves = [_Leaf(x) for x in leaves]
         self.step_count = 0
         self._schedule = warmup_decay_schedule(
             self.cfg.learning_rate, self.cfg.total_steps, self.cfg.warmup_steps)
         self._native = _load_native()
+        self.last_timings: dict = {}
+
+    # -- master access ----------------------------------------------------
+
+    def _check_tree(self, tree: Any) -> list:
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if treedef != self._treedef or len(leaves) != len(self._leaves):
+            raise ValueError("tree does not match the initialized param tree")
+        return leaves
 
     def load_masters(self, params_tree: Any) -> None:
         """Replace the fp32 masters (warm start / resume)."""
+        for leaf, x in zip(self._leaves, self._check_tree(params_tree)):
+            self._scatter(leaf, x, "p")
+
+    def _scatter(self, leaf: _Leaf, x, attr: str) -> None:
+        """Load a (global jax.Array or host numpy) value into leaf shards."""
+        shard_data = ({_index_key(s.index): s.data for s in x.addressable_shards}
+                      if hasattr(x, "addressable_shards") else None)
+        for key, shard in leaf.shards.items():
+            if shard_data is not None and key in shard_data:
+                val = shard_data[key]
+            else:
+                val = np.asarray(x)[shard.index]
+            setattr(shard, attr,
+                    np.array(val, np.float32, copy=True, order="C"))
+
+    def masters_tree(self) -> Any:
+        """fp32 masters as globally-sharded jax.Arrays (checkpoint input)."""
         import jax
 
-        leaves = jax.tree.leaves(params_tree)
-        if len(leaves) != len(self._params):
-            raise ValueError("params tree does not match")
-        self._params = [np.array(x, np.float32, copy=True, order="C") for x in leaves]
+        vals = [leaf.assemble({_index_key(s.index): s.p
+                               for s in leaf.shards.values()})
+                for leaf in self._leaves]
+        return jax.tree_util.tree_unflatten(self._treedef, vals)
 
-    @property
-    def params_tree(self) -> Any:
+    def moments_tree(self, attr: str) -> Any:
+        """One moment tree ("m" or "v") as globally-sharded jax.Arrays —
+        assembled alone so the checkpoint path can stream p/m/v one at a
+        time instead of materializing 12 bytes/param on device at once."""
         import jax
 
-        return jax.tree_util.tree_unflatten(self._treedef, self._params)
+        vals = [leaf.assemble({_index_key(s.index): getattr(s, attr)
+                               for s in leaf.shards.values()})
+                for leaf in self._leaves]
+        return jax.tree_util.tree_unflatten(self._treedef, vals)
 
-    def update(self, grads_tree: Any) -> Any:
-        """One clipped AdamW step; returns the updated fp32 master tree."""
+
+    def device_params(self, dtype=None) -> Any:
+        """The bf16 (or `dtype`) device working copy, cast on the HOST so the
+        H2D transfer moves half the bytes of an fp32 upload."""
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        dtype = dtype or jnp.bfloat16
+        use_bf16 = jnp.dtype(dtype) == jnp.dtype(jnp.bfloat16)
+        vals = []
+        for leaf in self._leaves:
+            cast = {}
+            for s in leaf.shards.values():
+                key = _index_key(s.index)
+                cast[key] = (_cast_bf16(s.p, self._native) if use_bf16
+                             else s.p.astype(dtype))
+            vals.append(leaf.assemble(cast))
+        # Cast + transfer DISPATCH only: device_put returns after enqueueing,
+        # so the wire time is absorbed by the next dispatched computation
+        # (blocking here would serialize away exactly the overlap we want).
+        self.last_timings["h2d_dispatch_ms"] = 1000 * (time.perf_counter() - t0)
+        return jax.tree_util.tree_unflatten(self._treedef, vals)
+
+    # -- the step ---------------------------------------------------------
+
+    def update(self, grads_tree: Any) -> None:
+        """One clipped AdamW step on every process-local shard."""
         import jax
 
-        grads = [np.ascontiguousarray(np.asarray(g, np.float32))
-                 for g in jax.tree.leaves(grads_tree)]
-        if len(grads) != len(self._params):
-            raise ValueError("grad tree does not match param tree")
+        glvs = self._check_tree(grads_tree)
+        t0 = time.perf_counter()
+        # Start EVERY shard's D2H first: transfers overlap each other and the
+        # per-shard host work below (np.asarray then only waits the tail).
+        for g in glvs:
+            if hasattr(g, "copy_to_host_async"):
+                g.copy_to_host_async()
+        grad_np: list[dict] = []
+        for leaf, g in zip(self._leaves, glvs):
+            shards = leaf.grad_shards(g)
+            grad_np.append({k: np.ascontiguousarray(np.asarray(v, np.float32))
+                            for k, v in shards.items()})
+        t1 = time.perf_counter()
 
-        # global-norm clip (reference grad clip 5.0, conf yaml:136)
-        if self._native is not None:
-            norm_sq = sum(self._native.l2_norm_sq(_fptr(g), g.size) for g in grads)
-        else:
-            norm_sq = sum(float((g.astype(np.float64) ** 2).sum()) for g in grads)
+        # Global grad norm: each distinct shard counted exactly once across
+        # the whole job (min-device ownership), then one host allreduce.
+        norm_sq = 0.0
+        for leaf, gnp in zip(self._leaves, grad_np):
+            for key, shard in leaf.shards.items():
+                if not shard.owner:
+                    continue
+                g = gnp[key]
+                if self._native is not None:
+                    norm_sq += self._native.l2_norm_sq(_fptr(g), g.size)
+                else:
+                    norm_sq += float((g.astype(np.float64) ** 2).sum())
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            norm_sq = float(multihost_utils.process_allgather(
+                np.asarray(norm_sq, np.float64)).sum())
         norm = float(np.sqrt(norm_sq))
         clip = self.cfg.max_grad_norm
         grad_scale = clip / norm if (clip and norm > clip) else 1.0
 
         self.step_count += 1
         lr = float(self._schedule(self.step_count - 1))
-        for p, m, v, g in zip(self._params, self._m, self._v, grads):
-            if self._native is not None:
-                self._native.adamw_step(
-                    _fptr(p), _fptr(m), _fptr(v), _fptr(g), p.size,
-                    lr, self.cfg.beta1, self.cfg.beta2, self.cfg.eps,
-                    self.cfg.weight_decay, self.step_count, grad_scale)
-            else:
-                _adamw_numpy(p, m, v, g, lr, self.cfg.beta1, self.cfg.beta2,
-                             self.cfg.eps, self.cfg.weight_decay,
-                             self.step_count, grad_scale)
+        for leaf, gnp in zip(self._leaves, grad_np):
+            for key, shard in leaf.shards.items():
+                g = gnp[key]
+                if self._native is not None:
+                    self._native.adamw_step(
+                        _fptr(shard.p), _fptr(shard.m), _fptr(shard.v),
+                        _fptr(g), shard.p.size,
+                        lr, self.cfg.beta1, self.cfg.beta2, self.cfg.eps,
+                        self.cfg.weight_decay, self.step_count, grad_scale)
+                else:
+                    _adamw_numpy(shard.p, shard.m, shard.v, g, lr,
+                                 self.cfg.beta1, self.cfg.beta2, self.cfg.eps,
+                                 self.cfg.weight_decay, self.step_count,
+                                 grad_scale)
+        t2 = time.perf_counter()
+        self.last_timings.update(d2h_ms=1000 * (t1 - t0),
+                                 update_ms=1000 * (t2 - t1))
         self.last_lr = lr
         self.last_grad_norm = norm
-        return self.params_tree
 
     # -- checkpoint integration ------------------------------------------
 
     def state_dict(self) -> dict:
-        """Moments as params-shaped TREES so the checkpoint engine's canonical
-        (topology-agnostic) layout transform applies to them too."""
-        import jax
-
-        unflatten = lambda leaves: jax.tree_util.tree_unflatten(self._treedef, leaves)
-        return {"m": unflatten(self._m), "v": unflatten(self._v),
+        """Moments as params-shaped TREES of globally-sharded arrays so the
+        checkpoint engine's canonical (topology-agnostic) layout transform
+        applies to them too."""
+        return {"m": self.moments_tree("m"), "v": self.moments_tree("v"),
                 "step_count": np.int64(self.step_count)}
 
     def load_state_dict(self, state: dict) -> None:
-        import jax
-
-        self._m = [np.array(x, np.float32, copy=True, order="C")
-                   for x in jax.tree.leaves(state["m"])]
-        self._v = [np.array(x, np.float32, copy=True, order="C")
-                   for x in jax.tree.leaves(state["v"])]
+        for leaf, x in zip(self._leaves, self._check_tree(state["m"])):
+            self._scatter(leaf, x, "m")
+        for leaf, x in zip(self._leaves, self._check_tree(state["v"])):
+            self._scatter(leaf, x, "v")
         self.step_count = int(state["step_count"])

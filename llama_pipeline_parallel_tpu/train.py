@@ -455,20 +455,28 @@ def _should_stop(local_flag: bool) -> bool:
     return bool(np.any(flags))
 
 
+def _offload_restore_is_single_host() -> None:
+    """Offload training is multi-host, but RESTORING into it is not yet:
+    the canonical restore templates carry no mesh sharding, so a restore on
+    a pod would materialize non-addressable arrays and crash confusingly."""
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "offloaded-optimizer restore (resume / model_name_or_path warm "
+            "start) is single-host for now; multi-host offload training "
+            "itself is supported")
+
+
 def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
                  loader, end_step, stacked_template, mgr) -> dict:
     """Host-offloaded-optimizer training setup (reference ZeRO-offload path,
     conf yaml:160-162): fp32 masters + Adam moments in host DRAM via
     optim/offload.py; the device holds only the bf16 working copy and runs
-    loss+grad. Grads stream D2H, fresh bf16 params H2D, every step."""
-    from jax.sharding import NamedSharding
+    loss+grad. Grads stream D2H (async, overlapped with the host kernel),
+    fresh bf16 params H2D (host-cast, half the bytes), every step. Masters
+    are sharded per process: each host keeps/updates only the shards its
+    devices hold (the ZeRO-offload distribution of the reference's 800 GB
+    65B state, README.md:70-71)."""
     from llama_pipeline_parallel_tpu.optim.offload import HostOffloadAdamW
-
-    if jax.process_count() > 1:
-        raise NotImplementedError(
-            "optimizer_offload currently supports single-process (single-host) "
-            "runs only: the host optimizer needs every master shard addressable "
-            "locally. Use the fused optimizer on pods.")
 
     output_dir = cfg["output_dir"]
     host = HostOffloadAdamW(ocfg)
@@ -482,23 +490,31 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
     resume_step = 0
     resume = mgr.latest_step() if cfg.get("resume", True) else None
     if resume is not None:
-        try:
-            p, o, resume_step = mgr.load(resume, stacked_template, host.state_dict(),
-                                         manifest)
-        except ValueError as e:
-            if not mgr.load_meta(resume).get("has_optimizer_state"):
-                raise  # accurate module-only message from CheckpointManager.load
+        meta = mgr.load_meta(resume)
+        if not meta.get("has_optimizer_state"):
             raise ValueError(
-                f"checkpoint-{resume}'s optimizer state does not match the "
-                f"host-offload layout — it was probably written by the fused "
-                f"(optax) optimizer. To continue those weights under the "
-                f"offloaded optimizer, point model_name_or_path at this "
-                f"checkpoint and use a fresh output_dir (module-only warm "
-                f"start; optimizer moments restart).") from e
-        host.load_masters(p)
-        host.load_state_dict(o)
+                f"checkpoint-{resume} has no optimizer state (module-only / "
+                f"converter output); point model_name_or_path at it instead")
+        layout = meta.get("opt_layout")
+        if layout != "offload_parts":
+            writer = ("the fused (optax) optimizer" if layout is None
+                      else f"an unknown optimizer layout {layout!r}")
+            raise ValueError(
+                f"checkpoint-{resume}'s optimizer state was written by "
+                f"{writer}, not the current offload layout. To continue "
+                f"those weights under the offloaded optimizer, point "
+                f"model_name_or_path at this checkpoint and use a fresh "
+                f"output_dir (module-only warm start; optimizer moments "
+                f"restart).")
+        _offload_restore_is_single_host()
+        host.load_masters(mgr.load_params(resume, stacked_template, manifest))
+        m, v, step_count = mgr.load_offload_moments(resume, stacked_template,
+                                                    manifest)
+        host.load_state_dict({"m": m, "v": v, "step_count": step_count})
+        resume_step = resume
         logger.info("resumed offloaded state from checkpoint-%d", resume_step)
     elif cfg.get("model_name_or_path"):
+        _offload_restore_is_single_host()
         warm = CheckpointManager(cfg["model_name_or_path"])
         warm_step = warm.latest_step()
         if warm_step is None:
@@ -506,30 +522,29 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
         host.load_masters(warm.load_params(warm_step, stacked_template, manifest))
         logger.info("warm-started offloaded masters from %s", cfg["model_name_or_path"])
 
-    param_specs = pl.stage_param_specs(stacked_template, tp=mesh.shape["tp"] > 1)
-    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs,
-                             is_leaf=lambda x: not isinstance(x, dict))
-    to_device = jax.jit(lambda p: llama.cast_params(p, model_cfg.dtype),
-                        out_shardings=shardings)
-
     seq_length = int(collator([dataset[0]])["input_ids"].shape[1])
+    if seq_length % mesh.shape["sp"]:
+        raise ValueError(f"sequence length {seq_length} must divide into "
+                         f"sp={mesh.shape['sp']} equal slabs")
     attn_fn = select_attention(cfg.get("attention", "auto"), seq_length, mesh,
                                sequence_parallel=cfg.get("sequence_parallel", "ring"))
     grad_fn = jax.jit(pl.make_pipeline_loss_and_grad(
         mesh, model_cfg, pcfg, stacked_template, attn_fn=attn_fn))
 
-    device_params_box = [to_device(host.params_tree)]
+    device_params_box = [host.device_params(model_cfg.dtype)]
 
     def do_step(batch):
         loss, grads = grad_fn(device_params_box[0], form_global_batch(mesh, batch))
         host.update(grads)
-        device_params_box[0] = to_device(host.params_tree)
-        return loss, lambda: {"lr": host.last_lr, "grad_norm": host.last_grad_norm}
+        device_params_box[0] = host.device_params(model_cfg.dtype)
+        return loss, lambda: {"lr": host.last_lr,
+                              "grad_norm": host.last_grad_norm,
+                              **{k: round(v, 2)
+                                 for k, v in host.last_timings.items()}}
 
     def do_save(step):
         barrier("pre-save")
-        path = mgr.save(step, host.params_tree, manifest, model_cfg,
-                        opt_state=host.state_dict())
+        path = mgr.save_offload(step, host, manifest, model_cfg)
         _sync_checkpoint(cfg, path)
 
     do_eval = _make_evaluator(cfg, mesh, model_cfg, pcfg, stacked_template,

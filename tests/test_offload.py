@@ -1,12 +1,14 @@
-"""Host-offloaded AdamW (C++ kernel) vs optax numerics."""
+"""Host-offloaded AdamW (C++ kernel, shard-aware) vs optax numerics."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from llama_pipeline_parallel_tpu.optim import OptimizerConfig, make_optimizer
 from llama_pipeline_parallel_tpu.optim import offload as off
+from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
 
 
 @pytest.fixture(scope="module")
@@ -21,6 +23,18 @@ def grads_like(tree, seed):
     return jax.tree.map(lambda x: jnp.asarray(rng.randn(*x.shape) * 2, jnp.float32), tree)
 
 
+def optax_reference(tree, cfg, n_steps):
+    tx, _ = make_optimizer(cfg)
+    opt_state = tx.init(tree)
+    params = tree
+    import optax
+
+    for step in range(n_steps):
+        updates, opt_state = tx.update(grads_like(tree, step), opt_state, params)
+        params = optax.apply_updates(params, updates)
+    return params
+
+
 def test_native_kernel_compiles():
     assert off._load_native() is not None, "g++ compile of csrc/host_adamw.cpp failed"
 
@@ -33,25 +47,48 @@ def test_matches_optax(tree, force_numpy, monkeypatch):
     cfg = OptimizerConfig(learning_rate=1e-2, weight_decay=0.1, beta1=0.9,
                           beta2=0.95, max_grad_norm=1.0, total_steps=100,
                           warmup_steps=10)
-    tx, _ = make_optimizer(cfg)
-    opt_state = tx.init(tree)
-    params_ref = tree
+    params_ref = optax_reference(tree, cfg, 5)
 
     host = off.HostOffloadAdamW(cfg)
     host.init(tree)
-
     for step in range(5):
-        g = grads_like(tree, step)
-        updates, opt_state = tx.update(g, opt_state, params_ref)
-        import optax
-
-        params_ref = optax.apply_updates(params_ref, updates)
-        params_host = host.update(g)
+        host.update(grads_like(tree, step))
 
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
-        params_ref, params_host)
+        params_ref, host.masters_tree())
     assert host.last_grad_norm > 0
+    assert host.last_timings["update_ms"] >= 0
+
+
+def test_sharded_masters_match_optax(tree, devices):
+    """Masters stored per mesh shard (pp x dp sharded + replicated leaves)
+    must step to the same values as the unsharded optax chain."""
+    mesh = make_mesh(MeshConfig(pp=2, dp=2))
+    shard_specs = {"a": P("pp"), "b": {"c": P()}}  # sharded + replicated leaf
+    put = lambda t: jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), t, shard_specs)
+    cfg = OptimizerConfig(learning_rate=1e-2, weight_decay=0.1,
+                          max_grad_norm=1.0, total_steps=100, warmup_steps=10)
+    params_ref = optax_reference(tree, cfg, 3)
+
+    host = off.HostOffloadAdamW(cfg)
+    host.init(put(tree))
+    assert len(host._leaves[0].shards) == 2   # "a" split over pp
+    assert len(host._leaves[1].shards) == 1   # replicated "c": one distinct shard
+    for step in range(3):
+        host.update(put(grads_like(tree, step)))
+
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+        params_ref, host.masters_tree())
+
+    # the bf16 working copy keeps the mesh sharding and the master values
+    dev = host.device_params(jnp.bfloat16)
+    assert dev["a"].sharding.spec == NamedSharding(mesh, P("pp")).spec
+    np.testing.assert_allclose(np.asarray(dev["a"], np.float32),
+                               np.asarray(host.masters_tree()["a"]),
+                               rtol=8e-3, atol=1e-5)
 
 
 def test_state_dict_roundtrip(tree):
@@ -59,24 +96,28 @@ def test_state_dict_roundtrip(tree):
     h1 = off.HostOffloadAdamW(cfg)
     h1.init(tree)
     h1.update(grads_like(tree, 0))
-    state = h1.state_dict()
 
     h2 = off.HostOffloadAdamW(cfg)
     h2.init(tree)
-    h2.load_state_dict(state)
-    p1 = h1.update(grads_like(tree, 1))
-    # h2 params must be synced to h1's before the next step for equality
-    h2._params = [p.copy() for p in h1._params]
-    # re-do: start both from identical params/moments
-    h1b = off.HostOffloadAdamW(cfg); h1b.init(tree)
-    h1b.update(grads_like(tree, 0))
-    h2b = off.HostOffloadAdamW(cfg); h2b.init(tree)
-    h2b.load_state_dict(h1b.state_dict())
-    h2b._params = [p.copy() for p in h1b._params]
-    a = h1b.update(grads_like(tree, 1))
-    b = h2b.update(grads_like(tree, 1))
+    h2.load_state_dict(h1.state_dict())
+    h2.load_masters(h1.masters_tree())
+
+    h1.update(grads_like(tree, 1))
+    h2.update(grads_like(tree, 1))
     jax.tree.map(lambda x, y: np.testing.assert_allclose(
-        np.asarray(x), np.asarray(y), rtol=0, atol=0), a, b)
+        np.asarray(x), np.asarray(y), rtol=0, atol=0),
+        h1.masters_tree(), h2.masters_tree())
+
+
+def test_bf16_host_cast_matches_device_cast(tree):
+    """The native round-to-nearest-even f32->bf16 must agree with XLA's."""
+    cfg = OptimizerConfig(total_steps=10, warmup_steps=1)
+    host = off.HostOffloadAdamW(cfg)
+    host.init(tree)
+    dev = host.device_params(jnp.bfloat16)
+    expected = jax.tree.map(lambda x: jnp.asarray(x).astype(jnp.bfloat16), tree)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a, np.float32), np.asarray(b, np.float32)), dev, expected)
 
 
 def test_mismatched_tree_raises(tree):
