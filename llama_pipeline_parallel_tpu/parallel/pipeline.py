@@ -37,13 +37,13 @@ Design (and why it is not a translation of DeepSpeed):
   allreduce; ZeRO-1-style opt-state sharding happens in optim/, over the same
   axis the reference shards over, conf yaml zero_optimization block).
 
-The compute order within a tick is identical on every device (SPMD), so the
-final-norm/lm-head/loss of a finished microbatch runs on every stage each
-tick (masked to the last stage's contribution). That costs one lm-head
-matmul per tick — a few percent of a stage's decoder layers at real model
-sizes — and in exchange nothing is ever collected into an M-sized buffer:
-per-flush activation memory is the stage-boundary carries alone, and
-`accum_chunks` bounds even those.
+Per-tick boundary costs: under "1f1b" at tp=1, embed and the
+final-norm/lm-head/loss head run under `lax.cond` on the stage index, so
+ONLY the first/last stage pays them (no masked replicated compute). Under
+"gpipe", and under "1f1b" with tp>1 (tp collectives cannot sit inside a
+stage-divergent branch), they run masked on every stage each tick — one
+lm-head matmul per tick of overhead; in exchange nothing is ever collected
+into an M-sized buffer.
 """
 
 from __future__ import annotations

@@ -105,17 +105,84 @@ def build_dataset_and_collator(cfg: dict, model_cfg: LlamaConfig) -> tuple[Any, 
     return ds, collator
 
 
+_AUTO_ATTN_CACHE: dict = {}
+
+
+def _measure_attention(model_cfg: LlamaConfig, seq_len: int) -> Any:
+    """Time exact vs flash (fwd+bwd, jitted, value-fetch barrier) at this
+    run's shape ON THE DEVICE and return the faster — `auto` picks by
+    measurement, not by threshold folklore. Cached per shape; any failure
+    falls back to the exact path."""
+    from llama_pipeline_parallel_tpu.ops.attention import attention
+    from llama_pipeline_parallel_tpu.ops.flash_attention import flash_attention
+
+    key = (seq_len, model_cfg.num_attention_heads, model_cfg.kv_heads,
+           model_cfg.head_dim)
+    if key in _AUTO_ATTN_CACHE:
+        return _AUTO_ATTN_CACHE[key]
+
+    def measure_locally():
+        import time
+
+        try:
+            rng = np.random.RandomState(0)
+            h, hkv, hd = (model_cfg.num_attention_heads, model_cfg.kv_heads,
+                          model_cfg.head_dim)
+            q = jnp.asarray(rng.randn(1, seq_len, h, hd), jnp.bfloat16)
+            k = jnp.asarray(rng.randn(1, seq_len, hkv, hd), jnp.bfloat16)
+            v = jnp.asarray(rng.randn(1, seq_len, hkv, hd), jnp.bfloat16)
+
+            def time_one(fn):
+                loss = lambda q, k, v: (fn(q, k, v, None, causal=True)
+                                        .astype(jnp.float32) ** 2).sum()
+                step = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+                float(step(q, k, v)[0])  # compile + barrier (value fetch)
+                t0 = time.perf_counter()
+                for _ in range(3):
+                    float(step(q, k, v)[0])
+                return (time.perf_counter() - t0) / 3
+
+            t_exact, t_flash = time_one(attention), time_one(flash_attention)
+            winner = flash_attention if t_flash < t_exact else attention
+            logger.info("attention=auto @ seq %d: exact %.2fms, flash %.2fms -> %s",
+                        seq_len, 1e3 * t_exact, 1e3 * t_flash,
+                        "flash" if winner is flash_attention else "exact")
+            return winner
+        except Exception as e:
+            logger.warning("attention=auto measurement failed (%r); using exact", e)
+            return attention
+
+    if jax.process_count() > 1:
+        # Every process must compile the SAME program: near-equal timings (or
+        # a one-host measurement failure) must not let hosts pick different
+        # kernels — process 0 measures, everyone takes its verdict.
+        from jax.experimental import multihost_utils
+
+        choice = 0
+        if jax.process_index() == 0:
+            choice = 1 if measure_locally() is flash_attention else 0
+        choice = int(multihost_utils.broadcast_one_to_all(np.int32(choice)))
+        winner = flash_attention if choice else attention
+    else:
+        winner = measure_locally()
+    _AUTO_ATTN_CACHE[key] = winner
+    return winner
+
+
 def select_attention(impl: str, seq_length: int, mesh,
-                     sequence_parallel: str = "ring") -> Any:
+                     sequence_parallel: str = "ring",
+                     model_cfg: LlamaConfig | None = None) -> Any:
     """'exact' | 'flash' | 'auto'. The reference tried and failed to enable
-    flash attention (README.md:141-143); here it is the default for long
-    sequences on TPU, where the exact path's O(L^2) scores dominate.
+    flash attention (README.md:141-143); here `auto` MEASURES both paths on
+    the device at the run's shape and keeps the faster.
 
     `seq_length` must be the ACTUAL batch sequence length (probe the
-    collator), not a config guess. `auto` falls back to the exact path when
-    the length the kernel actually sees does not tile into flash blocks —
-    under ring sequence parallelism that is the PER-SLAB length seq/sp
-    (Ulysses re-shards to the full sequence, so there it stays seq)."""
+    collator), not a config guess. The flash kernel's real tiling rule
+    (ops/flash_attention.py `_block_sizes`: blocks clamp to the sequence):
+    any length under 1024 tiles, longer ones need a 1024 multiple — checked
+    against the length the kernel actually SEES, which under ring sequence
+    parallelism is the per-slab seq/sp (Ulysses re-shards to the full
+    sequence, so there it stays seq)."""
     from llama_pipeline_parallel_tpu.ops.attention import attention
     from llama_pipeline_parallel_tpu.ops.flash_attention import flash_attention
 
@@ -128,13 +195,18 @@ def select_attention(impl: str, seq_length: int, mesh,
         kernel_len = seq_length // sp if (sp > 1 and sequence_parallel == "ring") \
             else seq_length
         on_tpu = mesh.devices.ravel()[0].platform == "tpu"
-        tiles = kernel_len % 1024 == 0  # must divide the flash block size
-        if on_tpu and kernel_len >= 2048 and not tiles:
+        tiles = kernel_len < 1024 or kernel_len % 1024 == 0
+        if not on_tpu:
+            return attention  # flash interpret mode off-TPU is far slower
+        if not tiles:
             logger.warning(
                 "attention=auto: kernel sequence length %d (seq %d / sp slab) "
                 "does not tile into flash blocks; using the exact path (pad to "
                 "a 1024 multiple to enable flash)", kernel_len, seq_length)
-        return flash_attention if (on_tpu and kernel_len >= 2048 and tiles) else attention
+            return attention
+        if model_cfg is None:
+            return flash_attention if kernel_len >= 2048 else attention
+        return _measure_attention(model_cfg, kernel_len)
     raise ValueError(f"unknown attention impl {impl!r} (use exact|flash|auto)")
 
 
@@ -248,7 +320,8 @@ def run_training(cfg: dict) -> dict:
         raise ValueError(f"sequence length {seq_length} must divide into "
                          f"sp={mesh_cfg.sp} equal slabs")
     attn_fn = select_attention(cfg.get("attention", "auto"), seq_length, mesh,
-                               sequence_parallel=cfg.get("sequence_parallel", "ring"))
+                               sequence_parallel=cfg.get("sequence_parallel", "ring"),
+                               model_cfg=model_cfg)
     step_fn = ts.make_train_step(mesh, model_cfg, pcfg, tx, schedule,
                                  stacked_template, attn_fn=attn_fn)
 
@@ -346,7 +419,8 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
     """
     output_dir = cfg["output_dir"]
     writer = MetricsWriter(output_dir, config_snapshot=cfg,
-                           use_wandb=cfg.get("use_wandb", False))
+                           use_wandb=cfg.get("use_wandb", False),
+                           use_tensorboard=cfg.get("use_tensorboard", False))
     meter = Throughput(model_cfg, seq_length, n_chips=mesh.devices.size)
     logging_steps = cfg.get("logging_steps", 10)
     save_steps = cfg.get("save_steps", 0)
@@ -527,7 +601,8 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
         raise ValueError(f"sequence length {seq_length} must divide into "
                          f"sp={mesh.shape['sp']} equal slabs")
     attn_fn = select_attention(cfg.get("attention", "auto"), seq_length, mesh,
-                               sequence_parallel=cfg.get("sequence_parallel", "ring"))
+                               sequence_parallel=cfg.get("sequence_parallel", "ring"),
+                               model_cfg=model_cfg)
     grad_fn = jax.jit(pl.make_pipeline_loss_and_grad(
         mesh, model_cfg, pcfg, stacked_template, attn_fn=attn_fn))
 

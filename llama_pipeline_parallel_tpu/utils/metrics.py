@@ -85,17 +85,20 @@ class Throughput:
 
 
 class MetricsWriter:
-    """Scalars -> stdout log + metrics.jsonl + wandb/tensorboard when present.
+    """Scalars -> stdout log + metrics.jsonl, plus wandb (`use_wandb`) and
+    tensorboard (`use_tensorboard`) sinks when their packages are present.
 
     The thin interface SURVEY.md §5.5 calls for; replaces the reference's
     hardcoded wandb calls (trainer_base_ds_mp.py:441-447,373-374) and its
     absent `WandbWriter` helper."""
 
     def __init__(self, output_dir: str, config_snapshot: dict | None = None,
-                 use_wandb: bool = False, project: str = "llama-pipeline-tpu"):
+                 use_wandb: bool = False, use_tensorboard: bool = False,
+                 project: str = "llama-pipeline-tpu"):
         os.makedirs(output_dir, exist_ok=True)
         self._f = open(os.path.join(output_dir, "metrics.jsonl"), "a", buffering=1)
         self._wandb = None
+        self._tb = None
         if config_snapshot is not None:
             # run provenance: resolved config snapshot next to the checkpoints
             # (reference trainer_base_ds_mp.py:439 saves training_config.yaml)
@@ -108,6 +111,14 @@ class MetricsWriter:
                 self._wandb = wandb.init(project=project, config=config_snapshot)
             except Exception as e:  # wandb not installed / offline
                 logger.warning("wandb unavailable (%r); falling back to jsonl only", e)
+        if use_tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(os.path.join(output_dir, "tensorboard"))
+            except Exception as e:
+                logger.warning("tensorboard unavailable (%r); falling back to "
+                               "jsonl only", e)
 
     def log(self, step: int, scalars: dict[str, Any]) -> None:
         record = {"step": step, **{k: _to_py(v) for k, v in scalars.items()}}
@@ -117,11 +128,17 @@ class MetricsWriter:
         logger.info(pretty)
         if self._wandb is not None:
             self._wandb.log(scalars, step=step)
+        if self._tb is not None:
+            for k, v in record.items():
+                if k != "step" and isinstance(v, (int, float)):
+                    self._tb.add_scalar(k, v, global_step=step)
 
     def close(self) -> None:
         self._f.close()
         if self._wandb is not None:
             self._wandb.finish()
+        if self._tb is not None:
+            self._tb.close()
 
 
 def _to_py(v: Any) -> Any:

@@ -1,0 +1,51 @@
+"""MetricsWriter sinks and throughput accounting."""
+
+import json
+import os
+
+import numpy as np
+
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+from llama_pipeline_parallel_tpu.utils.metrics import (
+    MetricsWriter,
+    Throughput,
+    param_count,
+    train_flops_per_token,
+)
+
+
+def test_jsonl_and_tensorboard_sinks(tmp_path):
+    w = MetricsWriter(str(tmp_path), config_snapshot={"lr": 1e-3},
+                      use_tensorboard=True)
+    w.log(1, {"loss": 2.5, "lr": 1e-3})
+    w.log(2, {"loss": np.float32(2.25)})
+    w.close()
+
+    lines = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    assert [l["step"] for l in lines] == [1, 2]
+    assert lines[1]["loss"] == 2.25
+    assert json.load(open(tmp_path / "training_config.json")) == {"lr": 1e-3}
+    tb_dir = tmp_path / "tensorboard"
+    assert tb_dir.is_dir() and any(os.scandir(tb_dir))  # an event file exists
+
+
+def test_throughput_meter_counts_mfu():
+    cfg = LlamaConfig.tiny()
+    meter = Throughput(cfg, seq_length=32, n_chips=2, peak_flops_per_chip=1e12)
+    meter.update(4096)
+    out = meter.read_and_reset()
+    assert out["tokens_per_sec"] > 0
+    assert out["tokens_per_sec_per_chip"] * 2 == out["tokens_per_sec"]
+    expected_mfu = train_flops_per_token(cfg, 32) * out["tokens_per_sec"] / 2e12
+    np.testing.assert_allclose(out["mfu"], expected_mfu, rtol=1e-6)
+
+
+def test_param_count_matches_init():
+    import jax
+
+    from llama_pipeline_parallel_tpu.models.llama import model as llama
+
+    cfg = LlamaConfig.tiny()
+    n_actual = sum(x.size for x in jax.tree.leaves(
+        llama.init_params(jax.random.PRNGKey(0), cfg)))
+    assert param_count(cfg) == n_actual
