@@ -1,27 +1,35 @@
 #!/usr/bin/env python
 """Single-chip training-throughput benchmark.
 
-Runs the real train-step path (pipeline machinery at PP=1, remat, bf16
-compute, fp32 AdamW with ZeRO-1 layout) on a ~550M-param LLaMA-shaped model at
-the reference workload shape (seq 512; reference conf yaml:32) and prints ONE
+Runs the real train-step path (pipeline machinery at PP=1, bf16 compute,
+fp32 AdamW with ZeRO-1 layout) on a ~550M-param LLaMA-shaped model at the
+reference workload shape (seq 512; reference conf yaml:32) and prints ONE
 JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-The reference publishes no throughput numbers (BASELINE.md), so vs_baseline
-is measured MFU / 0.45 — the 45%-MFU north-star from BASELINE.json.
+Sweeps the configuration knobs a user would actually tune on one chip —
+remat on/off (HBM is plentiful at this size; recompute is pure overhead when
+memory allows) and exact vs flash attention — and reports the BEST measured
+configuration as the headline, with every config's number in the detail
+field. The reference publishes no throughput numbers (BASELINE.md), so
+vs_baseline is measured MFU / 0.45 — the 45%-MFU north-star from
+BASELINE.json.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 
-def _watchdog(seconds: int):
+def _watchdog(seconds: int, report):
     """The TPU tunnel can wedge indefinitely (even trivial ops hang); emit a
     diagnostic JSON line instead of hanging the harness forever. Returns the
     timer; the caller cancels it the moment timing completes, BEFORE printing,
-    so exactly one JSON line is ever emitted.
+    so exactly one JSON line is ever emitted. If some sweep configs already
+    finished when the timer fires, their best number is reported (tagged
+    partial) rather than thrown away.
 
     A timer THREAD, not SIGALRM: the wedge sits in a blocking C call on the
     main thread, so a Python signal handler would never run — a thread still
@@ -29,10 +37,14 @@ def _watchdog(seconds: int):
     import threading
 
     def fire():
+        note = f"bench watchdog fired after {seconds}s (TPU unreachable?)"
+        if report():  # best completed config, if any
+            print(json.dumps({**report(), "partial": True, "error": note}),
+                  flush=True)
+            os._exit(0)
         print(json.dumps({
             "metric": "tokens_per_sec_per_chip", "value": 0.0,
-            "unit": "tokens/s/chip", "vs_baseline": 0.0,
-            "error": f"bench watchdog fired after {seconds}s (TPU unreachable?)",
+            "unit": "tokens/s/chip", "vs_baseline": 0.0, "error": note,
         }), flush=True)
         os._exit(2)
 
@@ -43,7 +55,29 @@ def _watchdog(seconds: int):
 
 
 def main() -> None:
-    watchdog = _watchdog(int(os.environ.get("BENCH_TIMEOUT_S", "900")))
+    results: dict[str, float] = {}
+    summary_ctx: dict = {}
+
+    def report():
+        if not results or not summary_ctx:
+            return None
+        best_name = min(results, key=results.get)
+        dt = results[best_name]
+        tps = summary_ctx["tokens_per_step"] / dt
+        mfu = summary_ctx["flops_token"] * tps / summary_ctx["peak"]
+        return {
+            "metric": "tokens_per_sec_per_chip",
+            "value": round(tps, 1),
+            "unit": "tokens/s/chip",
+            "vs_baseline": round(mfu / 0.45, 4),
+            "mfu": round(mfu, 4),
+            "step_time_ms": round(1000 * dt, 1),
+            "best_config": best_name,
+            "all_configs_ms": {k: round(1000 * v, 1) for k, v in results.items()},
+            "model": summary_ctx["model"],
+        }
+
+    watchdog = _watchdog(int(os.environ.get("BENCH_TIMEOUT_S", "1500")), report)
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -51,6 +85,8 @@ def main() -> None:
     from __graft_entry__ import _bench_config
     from llama_pipeline_parallel_tpu.models.llama import model as llama
     from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+    from llama_pipeline_parallel_tpu.ops.attention import attention
+    from llama_pipeline_parallel_tpu.ops.flash_attention import flash_attention
     from llama_pipeline_parallel_tpu.optim import OptimizerConfig, make_optimizer
     from llama_pipeline_parallel_tpu.parallel import pipeline as pl
     from llama_pipeline_parallel_tpu.parallel import train_step as ts
@@ -61,16 +97,15 @@ def main() -> None:
     )
 
     cfg = _bench_config()
-    batch_size, seq = 8, 512
+    batch_size = int(os.environ.get("BENCH_BATCH", "8"))
+    seq = int(os.environ.get("BENCH_SEQ", "512"))
+    n_steps = int(os.environ.get("BENCH_STEPS", "10"))
 
     mesh = make_mesh(MeshConfig())  # single chip
     manifest = StageManifest.for_config(cfg, 1)
     stacked = pl.stack_stages(llama.init_params(jax.random.PRNGKey(0), cfg), manifest)
-    pcfg = pl.PipelineConfig(num_stages=1, num_microbatches=1, remat=True)
     tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-4, total_steps=1000,
                                                warmup_steps=10))
-    state = ts.init_train_state(stacked, tx, mesh)
-    step = ts.make_train_step(mesh, cfg, pcfg, tx, sched, stacked)
 
     ids = np.random.RandomState(0).randint(3, cfg.vocab_size,
                                            (batch_size, seq)).astype(np.int32)
@@ -81,34 +116,63 @@ def main() -> None:
                                          (batch_size, seq)),
         "labels": jnp.asarray(ids),
     }
-
-    # warmup (compile) + steady-state timing. The loss VALUE is fetched every
-    # step: on the axon remote platform block_until_ready alone does not wait
-    # for the donated-state dependency chain, so value-fetch is the only
-    # reliable execution barrier (cost: one scalar D2H per step).
-    state, metrics = step(state, batch)
-    float(metrics["loss"])
-    n_steps = 10
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, metrics = step(state, batch)
-        float(metrics["loss"])
-    dt = time.perf_counter() - t0
-
     tokens_per_step = batch_size * seq
-    tps = tokens_per_step * n_steps / dt
     peak = detect_chip_peak_flops() or 197e12
-    mfu = train_flops_per_token(cfg, seq) * tps / peak
+    flops_token = train_flops_per_token(cfg, seq)
+    summary_ctx.update(tokens_per_step=tokens_per_step, peak=peak,
+                       flops_token=flops_token,
+                       model=f"llama-550m seq{seq} bs{batch_size} bf16 1f1b")
+
+    def measure(remat: bool, attn_name: str) -> float | None:
+        """Mean steady-state step seconds for one config; None if it fails
+        (e.g. flash unsupported shape / OOM with remat off) or its loss is
+        not finite (a fast-but-broken config must never win the headline)."""
+        import math
+
+        try:
+            attn_fn = flash_attention if attn_name == "flash" else attention
+            pcfg = pl.PipelineConfig(num_stages=1, num_microbatches=1, remat=remat)
+            state = ts.init_train_state(stacked, tx, mesh)
+            step = ts.make_train_step(mesh, cfg, pcfg, tx, sched, stacked,
+                                      attn_fn=attn_fn)
+            # warmup (compile) + steady-state timing. The loss VALUE is
+            # fetched every step: on the axon remote platform
+            # block_until_ready alone does not wait for the donated-state
+            # dependency chain, so value-fetch is the only reliable execution
+            # barrier (cost: one scalar D2H per step).
+            state, metrics = step(state, batch)
+            float(metrics["loss"])
+            t0 = time.perf_counter()
+            last = 0.0
+            for _ in range(n_steps):
+                state, metrics = step(state, batch)
+                last = float(metrics["loss"])
+            dt = (time.perf_counter() - t0) / n_steps
+            if not math.isfinite(last):
+                print(f"bench config remat={remat} attn={attn_name} produced "
+                      f"non-finite loss {last}; excluded", file=sys.stderr,
+                      flush=True)
+                return None
+            return dt
+        except Exception as e:
+            print(f"bench config remat={remat} attn={attn_name} failed: {e!r}",
+                  file=sys.stderr, flush=True)
+            return None
+
+    for remat in (False, True):
+        for attn_name in ("exact", "flash"):
+            dt = measure(remat, attn_name)
+            if dt is not None:
+                results[f"remat={int(remat)},attn={attn_name}"] = dt
+    summary = report()
     watchdog.cancel()
-    print(json.dumps({
-        "metric": "tokens_per_sec_per_chip",
-        "value": round(tps, 1),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(mfu / 0.45, 4),
-        "mfu": round(mfu, 4),
-        "step_time_ms": round(1000 * dt / n_steps, 1),
-        "model": "llama-550m seq512 bs8 bf16 remat",
-    }))
+    if summary is None:
+        print(json.dumps({
+            "metric": "tokens_per_sec_per_chip", "value": 0.0,
+            "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "error": "every bench configuration failed"}), flush=True)
+        sys.exit(1)
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

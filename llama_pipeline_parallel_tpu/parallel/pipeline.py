@@ -557,29 +557,34 @@ def _pipeline_1f1b_local(
     def tick(carry, t):
         x_recv, dy_recv, xbuf, gacc, loss_acc = carry
 
-        # -- forward half: microbatch t - stage ---------------------------
-        fm = t - stage
-        f_valid = (fm >= 0) & (fm < m_total)
-        fm_c = jnp.clip(fm, 0, m_total - 1)
-        ids_f, pad_f, cos_f, sin_f, _ = mb_data(fm_c)
-        y_f = stage_fwd(params, x_recv, ids_f, pad_f, cos_f, sin_f, None,
-                        with_loss=False)
-        # Buffer the raw received stage input for the later backward
-        # recompute (slot is free: a colliding index would be >= b_slots
-        # microbatches old, past its backward tick). The write is still
-        # predicated so drain-phase ticks (fm clipped onto m_total-1) can
-        # never clobber a live slot.
-        slot_f = fm_c % b_slots
-        old = jax.lax.dynamic_index_in_dim(xbuf, slot_f, keepdims=False)
-        xbuf = jax.lax.dynamic_update_index_in_dim(
-            xbuf, jnp.where(f_valid, x_recv, old), slot_f, 0)
+        if s_total > 1:
+            # -- forward half: microbatch t - stage -----------------------
+            fm = t - stage
+            f_valid = (fm >= 0) & (fm < m_total)
+            fm_c = jnp.clip(fm, 0, m_total - 1)
+            ids_f, pad_f, cos_f, sin_f, _ = mb_data(fm_c)
+            y_f = stage_fwd(params, x_recv, ids_f, pad_f, cos_f, sin_f, None,
+                            with_loss=False)
+            # Buffer the raw received stage input for the later backward
+            # recompute (slot is free: a colliding index would be >= b_slots
+            # microbatches old, past its backward tick). The write is still
+            # predicated so drain-phase ticks (fm clipped onto m_total-1) can
+            # never clobber a live slot.
+            slot_f = fm_c % b_slots
+            old = jax.lax.dynamic_index_in_dim(xbuf, slot_f, keepdims=False)
+            xbuf = jax.lax.dynamic_update_index_in_dim(
+                xbuf, jnp.where(f_valid, x_recv, old), slot_f, 0)
 
         # -- backward half: microbatch t - (2S - 2 - stage) ---------------
+        # (at S=1 the schedule degenerates to one vjp per tick — there is no
+        # handoff to produce, so the forward half above is skipped entirely
+        # and nothing is buffered: x_in is dead, stage 0's cond re-embeds)
         bm = t - (2 * (s_total - 1) - stage)
         b_valid = (bm >= 0) & (bm < m_total)
         bm_c = jnp.clip(bm, 0, m_total - 1)
         ids_b, pad_b, cos_b, sin_b, targets_b = mb_data(bm_c)
-        x_in_b = jax.lax.dynamic_index_in_dim(xbuf, bm_c % b_slots, keepdims=False)
+        x_in_b = (jax.lax.dynamic_index_in_dim(xbuf, bm_c % b_slots, keepdims=False)
+                  if s_total > 1 else x_recv)
 
         def h(p, x_in):
             return stage_fwd(p, x_in, ids_b, pad_b, cos_b, sin_b, targets_b,
@@ -601,7 +606,7 @@ def _pipeline_1f1b_local(
             x_next = jax.lax.ppermute(y_f, AXIS_PP, fwd_perm)
             dy_next = jax.lax.ppermute(dx, AXIS_PP, bwd_perm)
         else:
-            x_next, dy_next = y_f, dx
+            x_next, dy_next = x_recv, dx  # no neighbors; both carries dead
         return (x_next, dy_next, xbuf, gacc, loss_acc), None
 
     carry0 = (
