@@ -84,6 +84,13 @@ class PipelineConfig:
 
     num_stages: int
     num_microbatches: int
+    # Per-layer jax.checkpoint inside the backward. NOTE: the "1f1b" schedule
+    # already checkpoints at STAGE granularity (stage inputs buffered, stage
+    # recomputed in backward — DeepSpeed's activation-checkpointing contract),
+    # so under 1f1b this knob only bounds the TRANSIENT within-stage
+    # activations of the one microbatch being backpropped, at the cost of an
+    # extra forward per tick. Worth it for long sequences (16k), wasteful at
+    # short ones. Under "gpipe" it is the classic remat and usually required.
     remat: bool = True
     remat_policy: str = "nothing_saveable"
     # "1f1b" (default): one-forward-one-backward with a hand-written backward
