@@ -164,6 +164,7 @@ def main() -> None:
             dt = measure(remat, attn_name)
             if dt is not None:
                 results[f"remat={int(remat)},attn={attn_name}"] = dt
+
     summary = report()
     watchdog.cancel()
     if summary is None:
@@ -172,7 +173,28 @@ def main() -> None:
             "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": "every bench configuration failed"}), flush=True)
         sys.exit(1)
-    print(json.dumps(summary))
+    print(json.dumps(summary), flush=True)
+
+    # BENCH_PROFILE=<dir>: afterwards (the result JSON is already out, so a
+    # profiling failure or wedge can no longer cost the measurement), capture
+    # a profiler trace of the winning config's steady state — the per-op
+    # breakdown for the MFU hunt (SURVEY.md §5.1).
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if profile_dir:
+        import threading
+
+        threading.Timer(600, lambda: os._exit(0)).start()  # wedge guard
+        best = summary["best_config"]
+        try:
+            jax.profiler.start_trace(profile_dir)
+            ok = measure(best.startswith("remat=1"), best.split("attn=")[1])
+            jax.profiler.stop_trace()
+            print(f"profiler trace for {best} "
+                  f"{'written to ' + profile_dir if ok is not None else 'FAILED'}",
+                  file=sys.stderr, flush=True)
+        except Exception as e:
+            print(f"profiling failed: {e!r}", file=sys.stderr, flush=True)
+        os._exit(0)  # the timer thread is non-daemon by design; don't join it
 
 
 if __name__ == "__main__":
