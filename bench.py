@@ -123,10 +123,12 @@ def main() -> None:
                        flops_token=flops_token,
                        model=f"llama-550m seq{seq} bs{batch_size} bf16 1f1b")
 
-    def measure(remat: bool, attn_name: str) -> float | None:
+    def measure(remat: bool, attn_name: str, trace_dir: str | None = None) -> float | None:
         """Mean steady-state step seconds for one config; None if it fails
         (e.g. flash unsupported shape / OOM with remat off) or its loss is
-        not finite (a fast-but-broken config must never win the headline)."""
+        not finite (a fast-but-broken config must never win the headline).
+        `trace_dir` captures a profiler trace of the timed loop only (the
+        warmup/compile step stays outside the trace)."""
         import math
 
         try:
@@ -142,12 +144,16 @@ def main() -> None:
             # barrier (cost: one scalar D2H per step).
             state, metrics = step(state, batch)
             float(metrics["loss"])
+            if trace_dir:
+                jax.profiler.start_trace(trace_dir)
             t0 = time.perf_counter()
             last = 0.0
             for _ in range(n_steps):
                 state, metrics = step(state, batch)
                 last = float(metrics["loss"])
             dt = (time.perf_counter() - t0) / n_steps
+            if trace_dir:
+                jax.profiler.stop_trace()
             if not math.isfinite(last):
                 print(f"bench config remat={remat} attn={attn_name} produced "
                       f"non-finite loss {last}; excluded", file=sys.stderr,
@@ -159,11 +165,12 @@ def main() -> None:
                   file=sys.stderr, flush=True)
             return None
 
-    for remat in (False, True):
-        for attn_name in ("exact", "flash"):
-            dt = measure(remat, attn_name)
-            if dt is not None:
-                results[f"remat={int(remat)},attn={attn_name}"] = dt
+    configs = {f"remat={int(remat)},attn={attn_name}": (remat, attn_name)
+               for remat in (False, True) for attn_name in ("exact", "flash")}
+    for name, (remat, attn_name) in configs.items():
+        dt = measure(remat, attn_name)
+        if dt is not None:
+            results[name] = dt
 
     summary = report()
     watchdog.cancel()
@@ -186,9 +193,7 @@ def main() -> None:
         threading.Timer(600, lambda: os._exit(0)).start()  # wedge guard
         best = summary["best_config"]
         try:
-            jax.profiler.start_trace(profile_dir)
-            ok = measure(best.startswith("remat=1"), best.split("attn=")[1])
-            jax.profiler.stop_trace()
+            ok = measure(*configs[best], trace_dir=profile_dir)
             print(f"profiler trace for {best} "
                   f"{'written to ' + profile_dir if ok is not None else 'FAILED'}",
                   file=sys.stderr, flush=True)

@@ -154,6 +154,35 @@ def test_pp8_headline_topology(devices):
     assert_tree_close(grads, ref_grads)
 
 
+def test_1f1b_memory_bounded_in_microbatches(cfg, params, devices):
+    """THE point of 1F1B (VERDICT round-1 item 3's acceptance criterion):
+    in-flight activation memory must not grow with the grad-accumulation
+    depth M. XLA's compile-time memory analysis makes the claim checkable
+    without hardware: the 1f1b program's temp allocation stays ~flat from
+    M=8 to M=64 while the AD-differentiated gpipe program's grows ~linearly
+    (it stores one boundary activation per tick)."""
+    mesh = make_mesh(MeshConfig(pp=4))
+    manifest = StageManifest.for_config(cfg, 4)
+    stacked = pl.stack_stages(params, manifest)
+
+    def temp_bytes(schedule, m):
+        batch = make_batch(cfg, batch_size=m, seqlen=16)
+        pcfg = pl.PipelineConfig(num_stages=4, num_microbatches=m,
+                                 schedule=schedule)
+        fn = jax.jit(pl.make_pipeline_loss_and_grad(mesh, cfg, pcfg, stacked))
+        analysis = fn.lower(stacked, batch).compile().memory_analysis()
+        if analysis is None or not getattr(analysis, "temp_size_in_bytes", 0):
+            pytest.skip("backend exposes no compile-time memory analysis")
+        return analysis.temp_size_in_bytes
+
+    growth_1f1b = temp_bytes("1f1b", 64) / temp_bytes("1f1b", 8)
+    growth_gpipe = temp_bytes("gpipe", 64) / temp_bytes("gpipe", 8)
+    assert growth_1f1b < 1.3, f"1f1b temp memory grew {growth_1f1b:.2f}x in M"
+    assert growth_gpipe > 1.8, (
+        f"gpipe grew only {growth_gpipe:.2f}x — if XLA learned to bound it, "
+        f"revisit whether the 1f1b schedule is still the memory win")
+
+
 def test_stack_unstack_roundtrip(cfg, params):
     man = StageManifest.for_config(cfg, 4)
     rt = pl.unstack_stages(pl.stack_stages(params, man), man)
