@@ -146,14 +146,16 @@ def main() -> None:
             float(metrics["loss"])
             if trace_dir:
                 jax.profiler.start_trace(trace_dir)
-            t0 = time.perf_counter()
-            last = 0.0
-            for _ in range(n_steps):
-                state, metrics = step(state, batch)
-                last = float(metrics["loss"])
-            dt = (time.perf_counter() - t0) / n_steps
-            if trace_dir:
-                jax.profiler.stop_trace()
+            try:
+                t0 = time.perf_counter()
+                last = 0.0
+                for _ in range(n_steps):
+                    state, metrics = step(state, batch)
+                    last = float(metrics["loss"])
+                dt = (time.perf_counter() - t0) / n_steps
+            finally:
+                if trace_dir:  # finalize whatever was captured, even on error
+                    jax.profiler.stop_trace()
             if not math.isfinite(last):
                 print(f"bench config remat={remat} attn={attn_name} produced "
                       f"non-finite loss {last}; excluded", file=sys.stderr,

@@ -14,6 +14,10 @@ from llama_pipeline_parallel_tpu.parallel.pipeline import (  # noqa: F401
     unstack_stages,
 )
 from llama_pipeline_parallel_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+from llama_pipeline_parallel_tpu.parallel.sp import (  # noqa: F401
+    SP_STRATEGIES,
+    make_sp_attention,
+)
 from llama_pipeline_parallel_tpu.parallel.train_step import (  # noqa: F401
     TrainState,
     init_params_sharded,
