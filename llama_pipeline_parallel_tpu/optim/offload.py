@@ -47,7 +47,8 @@ from llama_pipeline_parallel_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-_CSRC = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+# inside the package so installed wheels ship the kernel source too
+_CSRC = os.path.join(os.path.dirname(__file__), os.pardir,
                      "csrc", "host_adamw.cpp")
 _lib = None
 _lib_failed = False

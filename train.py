@@ -1,38 +1,14 @@
 #!/usr/bin/env python
 """CLI entry point: `python train.py --config conf/<name>.yaml [key=value ...]`.
 
-Replaces the reference's Hydra `__main__` shim (reference
-trainer_base_ds_mp.py:461-473): overrides accept both `key=value` and
-`--key=value` forms.
-"""
+Thin launcher over `llama_pipeline_parallel_tpu.cli` (also installed as the
+`lpt-train` console script — see pyproject.toml)."""
 
 from __future__ import annotations
 
-import argparse
 import sys
 
-
-def main(argv: list[str] | None = None) -> None:
-    p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--config", required=True, help="path to a YAML config")
-    p.add_argument("--platform", default=None,
-                   help="force a jax platform (e.g. 'cpu' for smoke runs with "
-                        "XLA_FLAGS=--xla_force_host_platform_device_count=N)")
-    p.add_argument("overrides", nargs="*", help="key=value config overrides")
-    args = p.parse_args(argv)
-
-    if args.platform:
-        import jax
-
-        jax.config.update("jax_platforms", args.platform)
-
-    from llama_pipeline_parallel_tpu.train import run_training
-    from llama_pipeline_parallel_tpu.utils.config import load_config
-
-    cfg = load_config(args.config, args.overrides)
-    summary = run_training(cfg)
-    print(f"training done: {summary}")
-
+from llama_pipeline_parallel_tpu.cli import main
 
 if __name__ == "__main__":
     sys.exit(main())
