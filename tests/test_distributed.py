@@ -35,3 +35,17 @@ def test_form_global_batch_places_dp_sharded(devices):
     spec = arr.sharding.spec
     assert tuple(spec)[0] == "dp"
     np.testing.assert_array_equal(np.asarray(arr), batch["input_ids"])
+
+
+def test_form_global_batch_shards_sequence_over_sp(devices):
+    """With an sp axis the sequence dim is sharded too: each device holds a
+    [rows/dp, seq/sp] slab of the right slice, and values round-trip."""
+    mesh = make_mesh(MeshConfig(dp=2, sp=4))
+    batch = {"input_ids": np.arange(64).reshape(4, 16).astype(np.int32)}
+    arr = form_global_batch(mesh, batch)["input_ids"]
+    assert tuple(arr.sharding.spec) == ("dp", "sp")
+    np.testing.assert_array_equal(np.asarray(arr), batch["input_ids"])
+    for shard in arr.addressable_shards:
+        assert shard.data.shape == (2, 4)  # 4/dp rows x 16/sp columns
+        np.testing.assert_array_equal(np.asarray(shard.data),
+                                      batch["input_ids"][shard.index])
