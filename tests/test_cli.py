@@ -51,3 +51,39 @@ def test_offload_finds_packaged_kernel():
     from llama_pipeline_parallel_tpu.optim import offload
 
     assert os.path.isfile(os.path.abspath(offload._CSRC))
+
+
+def test_inspect_ckpt_tool(tmp_path, devices):
+    """tools/inspect_ckpt.py reports steps, completeness, partition, layout."""
+    import jax
+
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
+    from llama_pipeline_parallel_tpu.models.llama import model as llama
+    from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+    from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+    from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir, "tools"))
+    import inspect_ckpt
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=3)
+    man = StageManifest(num_layers=3, num_stages=2, layer_counts=(2, 1))
+    stacked = pl.stack_stages(llama.init_params(jax.random.PRNGKey(0), cfg), man)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(5, stacked, man, cfg)
+    (tmp_path / "checkpoint-9").mkdir()  # interrupted save: no meta.json
+
+    info = inspect_ckpt.describe(str(tmp_path))
+    assert info["latest_complete_step"] == 5
+    assert info["steps"][5] == "complete"
+    assert "INCOMPLETE" in info["steps"][9]
+    ck = info["checkpoint"]
+    assert tuple(ck["stage_partition"]) == (2, 1)
+    assert ck["optimizer_state"].startswith("none")
+    assert "params" in ck["items_on_disk"]
+
+    # inspecting the interrupted step reports, not crashes
+    partial = inspect_ckpt.describe(str(tmp_path), step=9)
+    assert "INCOMPLETE" in partial["checkpoint"]["status"]
+    with pytest.raises(ValueError, match="not found"):
+        inspect_ckpt.describe(str(tmp_path), step=50)

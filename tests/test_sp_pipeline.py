@@ -102,6 +102,20 @@ def test_ulysses_head_divisibility_guard(cfg, params, devices):
         pl.make_pipeline_loss_and_grad(mesh, cfg, pcfg, stacked)
 
 
+def test_trainer_rejects_indivisible_sp_sequence(devices, tmp_path):
+    """The trainer validates seq % sp up front with a clear message instead
+    of a cryptic GSPMD sharding error at first jit."""
+    from llama_pipeline_parallel_tpu.train import run_training
+
+    cfg = {"output_dir": str(tmp_path), "mesh": {"sp": 4},
+           "model": {"preset": "tiny", "dtype": "float32"},
+           "dataset": {"synthetic": True, "seq_length": 30,
+                       "pseudo_dataset_len": 8},
+           "per_device_train_batch_size": 2, "max_steps": 2, "warmup_steps": 1}
+    with pytest.raises(ValueError, match="equal slabs"):
+        run_training(cfg)
+
+
 def test_16k_ladder_config_runs_tiny(devices, tmp_path):
     """The shipped 16k stress config (BASELINE.md ladder #5) drives the real
     trainer end-to-end at tiny scale: same mesh axes (pp=2, sp=4), same

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Inspect a checkpoint directory: steps, completeness, manifest, layout.
+
+Usage: python tools/inspect_ckpt.py <output_dir> [--step N]
+
+The operational counterpart of the reference's ad-hoc `ls` +
+`latest`-tag-reading workflow (reference convert2ckpt.py:76-77,
+trainer_base_ds_mp.py:452-455): answers "what can I resume from, under
+which topology, with which optimizer layout" without loading any arrays.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def describe(root: str, step: int | None = None) -> dict:
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import (
+        _CKPT_RE,
+        LATEST_TAG,
+        CheckpointManager,
+    )
+
+    if not os.path.isdir(root):
+        raise FileNotFoundError(f"no such directory: {root}")
+    mgr = CheckpointManager(root)
+    tag = None
+    tag_path = os.path.join(root, LATEST_TAG)
+    if os.path.exists(tag_path):
+        tag = open(tag_path).read().strip()
+    steps = sorted(int(m.group(1)) for d in os.listdir(root)
+                   if (m := _CKPT_RE.match(d)))
+    out = {
+        "root": os.path.abspath(root),
+        "latest_tag": tag,
+        "latest_complete_step": mgr.latest_step(),
+        "steps": {
+            s: ("complete" if mgr._is_complete(f"checkpoint-{s}")
+                else "INCOMPLETE (no meta.json — interrupted save, ignored "
+                     "by resume)")
+            for s in steps
+        },
+    }
+    inspect_step = step if step is not None else mgr.latest_step()
+    if step is not None and step not in steps:
+        raise ValueError(f"step {step} not found under {root}; "
+                         f"available: {steps or 'none'}")
+    if inspect_step is not None and not mgr._is_complete(f"checkpoint-{inspect_step}"):
+        out["checkpoint"] = {
+            "step": inspect_step,
+            "status": "INCOMPLETE — no meta.json (interrupted save); "
+                      "arrays may be partial, resume ignores this step",
+        }
+        return out
+    if inspect_step is not None and inspect_step in steps:
+        meta = mgr.load_meta(inspect_step)
+        man = meta.get("manifest", {})
+        out["checkpoint"] = {
+            "step": meta.get("step"),
+            "stage_partition": (man.get("layer_counts")
+                                or f"even: {man.get('num_layers')} layers / "
+                                   f"{man.get('num_stages')} stages"),
+            "model_config": meta.get("model_config"),
+            "optimizer_state": (
+                "none (module-only / converter output)"
+                if not meta.get("has_optimizer_state") else
+                meta.get("opt_layout", "fused (optax)")),
+            "format_version": meta.get("format_version"),
+            "items_on_disk": sorted(
+                d for d in os.listdir(mgr.step_dir(inspect_step))
+                if os.path.isdir(os.path.join(mgr.step_dir(inspect_step), d))),
+        }
+    return out
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("root", help="checkpoint output_dir")
+    p.add_argument("--step", type=int, default=None,
+                   help="inspect a specific step (default: latest complete)")
+    args = p.parse_args(argv)
+    print(json.dumps(describe(args.root, args.step), indent=2, default=str))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
