@@ -90,17 +90,32 @@ class CheckpointManager:
         # save leaves a dir that must be ignored, not resumed from.
         return os.path.isfile(os.path.join(self.root, name, "meta.json"))
 
-    def latest_step(self) -> int | None:
+    def latest_tag_value(self) -> str | None:
+        """Raw contents of the `latest` tag file, if present."""
         tag = os.path.join(self.root, LATEST_TAG)
-        if os.path.exists(tag):
-            with open(tag) as f:
-                name = f.read().strip()
+        if not os.path.exists(tag):
+            return None
+        with open(tag) as f:
+            return f.read().strip()
+
+    def list_steps(self, complete_only: bool = False) -> list[int]:
+        """All checkpoint-N step numbers on disk, ascending."""
+        return sorted(int(m.group(1)) for d in os.listdir(self.root)
+                      if (m := _CKPT_RE.match(d))
+                      and (not complete_only or self.is_complete(int(m.group(1)))))
+
+    def is_complete(self, step: int) -> bool:
+        """Whether checkpoint-<step> finished durably (meta.json present)."""
+        return self._is_complete(f"checkpoint-{step}")
+
+    def latest_step(self) -> int | None:
+        name = self.latest_tag_value()
+        if name is not None:
             m = _CKPT_RE.match(name)
             if m and self._is_complete(name):
                 return int(m.group(1))
             logger.warning("stale latest tag %r; falling back to directory scan", name)
-        steps = [int(m.group(1)) for d in os.listdir(self.root)
-                 if (m := _CKPT_RE.match(d)) and self._is_complete(d)]
+        steps = self.list_steps(complete_only=True)
         return max(steps) if steps else None
 
     # -- save -------------------------------------------------------------

@@ -18,6 +18,11 @@ from llama_pipeline_parallel_tpu.parallel.sp import (  # noqa: F401
     SP_STRATEGIES,
     make_sp_attention,
 )
+from llama_pipeline_parallel_tpu.parallel.tp import (  # noqa: F401
+    tp_copy,
+    tp_max,
+    tp_reduce,
+)
 from llama_pipeline_parallel_tpu.parallel.train_step import (  # noqa: F401
     TrainState,
     init_params_sharded,

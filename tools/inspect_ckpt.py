@@ -18,27 +18,18 @@ import sys
 
 
 def describe(root: str, step: int | None = None) -> dict:
-    from llama_pipeline_parallel_tpu.ckpt.checkpoint import (
-        _CKPT_RE,
-        LATEST_TAG,
-        CheckpointManager,
-    )
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
 
     if not os.path.isdir(root):
         raise FileNotFoundError(f"no such directory: {root}")
     mgr = CheckpointManager(root)
-    tag = None
-    tag_path = os.path.join(root, LATEST_TAG)
-    if os.path.exists(tag_path):
-        tag = open(tag_path).read().strip()
-    steps = sorted(int(m.group(1)) for d in os.listdir(root)
-                   if (m := _CKPT_RE.match(d)))
+    steps = mgr.list_steps()
     out = {
         "root": os.path.abspath(root),
-        "latest_tag": tag,
+        "latest_tag": mgr.latest_tag_value(),
         "latest_complete_step": mgr.latest_step(),
         "steps": {
-            s: ("complete" if mgr._is_complete(f"checkpoint-{s}")
+            s: ("complete" if mgr.is_complete(s)
                 else "INCOMPLETE (no meta.json — interrupted save, ignored "
                      "by resume)")
             for s in steps
@@ -48,7 +39,7 @@ def describe(root: str, step: int | None = None) -> dict:
     if step is not None and step not in steps:
         raise ValueError(f"step {step} not found under {root}; "
                          f"available: {steps or 'none'}")
-    if inspect_step is not None and not mgr._is_complete(f"checkpoint-{inspect_step}"):
+    if inspect_step is not None and not mgr.is_complete(inspect_step):
         out["checkpoint"] = {
             "step": inspect_step,
             "status": "INCOMPLETE — no meta.json (interrupted save); "
