@@ -99,14 +99,20 @@ class CheckpointManager:
             return f.read().strip()
 
     def list_steps(self, complete_only: bool = False) -> list[int]:
-        """All checkpoint-N step numbers on disk, ascending."""
+        """All checkpoint-N step numbers on disk, ascending. Completeness is
+        probed on the ACTUAL dirname, so non-canonical spellings (e.g. a
+        hand-copied 'checkpoint-007') are still recognized."""
         return sorted(int(m.group(1)) for d in os.listdir(self.root)
                       if (m := _CKPT_RE.match(d))
-                      and (not complete_only or self.is_complete(int(m.group(1)))))
+                      and (not complete_only or self._is_complete(d)))
 
     def is_complete(self, step: int) -> bool:
         """Whether checkpoint-<step> finished durably (meta.json present)."""
-        return self._is_complete(f"checkpoint-{step}")
+        for d in os.listdir(self.root):
+            m = _CKPT_RE.match(d)
+            if m and int(m.group(1)) == step:
+                return self._is_complete(d)
+        return False
 
     def latest_step(self) -> int | None:
         name = self.latest_tag_value()

@@ -264,6 +264,17 @@ class HostOffloadAdamW:
                 for leaf in self._leaves]
         return jax.tree_util.tree_unflatten(self._treedef, vals)
 
+    def abstract_tree(self) -> Any:
+        """ShapeDtypeStruct tree of the fp32 masters WITH their mesh
+        shardings — the restore template that keeps checkpoint loads sharded
+        (no leaf ever funnels through a single device)."""
+        import jax
+
+        vals = [jax.ShapeDtypeStruct(leaf.global_shape, np.float32,
+                                     sharding=leaf.sharding)
+                for leaf in self._leaves]
+        return jax.tree_util.tree_unflatten(self._treedef, vals)
+
     def moments_tree(self, attr: str) -> Any:
         """One moment tree ("m" or "v") as globally-sharded jax.Arrays —
         assembled alone so the checkpoint path can stream p/m/v one at a

@@ -152,8 +152,33 @@ class PipelineConfig:
 def _reshape_leaf(x, shape: tuple[int, ...]):
     # works for concrete arrays AND abstract ShapeDtypeStruct templates
     if isinstance(x, jax.ShapeDtypeStruct):
-        return jax.ShapeDtypeStruct(shape, x.dtype)
+        return jax.ShapeDtypeStruct(shape, x.dtype,
+                                    sharding=_reshaped_sharding(x, shape))
     return x.reshape(shape)
+
+
+def _reshaped_sharding(x: jax.ShapeDtypeStruct, shape: tuple[int, ...]):
+    """Carry a template's NamedSharding through the stacked<->canonical
+    reshape when the mapping is expressible: merging [S, k, ...] -> [S*k, ...]
+    (or splitting back) keeps the leading-axis sharding as long as the k dim
+    is unsharded — each stage's k layers are one contiguous block. Restores
+    then place arrays SHARDED (65B canonical params never funnel through one
+    device); inexpressible cases (uneven partitions) drop to unsharded."""
+    from jax.sharding import NamedSharding
+
+    s = getattr(x, "sharding", None)
+    if not isinstance(s, NamedSharding):
+        return None
+    spec = list(s.spec) + [None] * (len(x.shape) - len(s.spec))
+    if len(shape) == len(x.shape) - 1 and x.shape[0] * x.shape[1] == shape[0]:
+        if spec[1] is None:  # merge (unstack): [S, k, ...] -> [n, ...]
+            return NamedSharding(s.mesh, P(spec[0], *spec[2:]))
+    elif len(shape) == len(x.shape) + 1 and shape[0] * shape[1] == x.shape[0]:
+        axis = spec[0]  # split (stack): [n, ...] -> [S, k, ...]
+        n_shards = 1 if axis is None else s.mesh.shape[axis]
+        if shape[0] % n_shards == 0:  # stage blocks align with shard blocks
+            return NamedSharding(s.mesh, P(axis, None, *spec[1:]))
+    return None
 
 
 def stack_stages(params: Params, manifest: StageManifest) -> Params:

@@ -530,14 +530,17 @@ def _should_stop(local_flag: bool) -> bool:
 
 
 def _offload_restore_is_single_host() -> None:
-    """Offload training is multi-host, but RESTORING into it is not yet:
-    the canonical restore templates carry no mesh sharding, so a restore on
-    a pod would materialize non-addressable arrays and crash confusingly."""
+    """Offload training is multi-host, but RESTORING into it stays gated:
+    the restore templates now carry mesh shardings end to end
+    (host.abstract_tree + the sharding-preserving canonical reshape), so the
+    machinery is in place — but this environment is single-host, so the
+    multi-process restore path has never executed on a real pod. Lift this
+    guard after one successful pod-validated resume."""
     if jax.process_count() > 1:
         raise NotImplementedError(
             "offloaded-optimizer restore (resume / model_name_or_path warm "
-            "start) is single-host for now; multi-host offload training "
-            "itself is supported")
+            "start) is single-host until pod-validated; multi-host offload "
+            "training itself is supported")
 
 
 def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
@@ -556,10 +559,9 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
     host = HostOffloadAdamW(ocfg)
     host.init(stacked_template)
     # fp32 masters now live on the host; drop the device fp32 init copy and
-    # keep only abstract shapes as the structure template (HBM holds just the
-    # bf16 working copy, the point of the offload path)
-    stacked_template = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), stacked_template)
+    # keep only SHARDED abstract structs as the template (HBM holds just the
+    # bf16 working copy; restores place arrays pre-sharded from these)
+    stacked_template = host.abstract_tree()
 
     resume_step = 0
     resume = mgr.latest_step() if cfg.get("resume", True) else None

@@ -120,6 +120,38 @@ def test_bf16_host_cast_matches_device_cast(tree):
         np.asarray(a, np.float32), np.asarray(b, np.float32)), dev, expected)
 
 
+def test_offload_checkpoint_restores_sharded(tmp_path, devices):
+    """save_offload -> load with the host's sharded abstract template: the
+    restored params keep the pp sharding end to end (at 65B an unsharded
+    restore would funnel whole canonical leaves through one device)."""
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
+    from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+    from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+    from llama_pipeline_parallel_tpu.parallel import train_step as ts
+
+    mcfg = LlamaConfig.tiny()
+    mesh = make_mesh(MeshConfig(pp=4))
+    man = StageManifest.for_config(mcfg, 4)
+    stacked = ts.init_params_sharded(jax.random.PRNGKey(0), mcfg, mesh, man)
+
+    cfg = OptimizerConfig(learning_rate=1e-2, total_steps=10, warmup_steps=1)
+    host = off.HostOffloadAdamW(cfg)
+    host.init(stacked)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save_offload(3, host, man, mcfg)
+
+    template = host.abstract_tree()
+    assert tuple(template["layers"]["attn"]["wq"].sharding.spec)[0] == "pp"
+    restored = mgr.load_params(3, template, man)
+    wq = restored["layers"]["attn"]["wq"]
+    assert tuple(wq.sharding.spec)[0] == "pp"  # never funneled to one device
+    np.testing.assert_array_equal(
+        np.asarray(wq), np.asarray(stacked["layers"]["attn"]["wq"]))
+    m, v, step_count = mgr.load_offload_moments(3, template, man)
+    assert step_count == 0
+    np.testing.assert_array_equal(np.asarray(m["norm"]), 0.0)
+
+
 def test_mismatched_tree_raises(tree):
     cfg = OptimizerConfig(total_steps=10, warmup_steps=1)
     h = off.HostOffloadAdamW(cfg)
