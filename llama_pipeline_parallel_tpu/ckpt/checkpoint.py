@@ -248,6 +248,34 @@ class CheckpointManager:
         return params, opt_state, int(meta["step"])
 
 
+def load_module_checkpoint(checkpoint_dir: str, step: int | None = None
+                           ) -> tuple[dict, LlamaConfig, StageManifest, int]:
+    """Canonical-layout params + config + manifest from a checkpoint dir.
+
+    The one loader standalone tools share (tools/export_hf.py,
+    tools/generate.py): resolves `step` (default: latest), rebuilds the
+    LlamaConfig/StageManifest from meta.json, and returns params with layer
+    leaves `[num_layers, ...]` (unstacked). Dtypes come from the config's
+    defaults, not the training run's — tools cast as they need.
+    """
+    from llama_pipeline_parallel_tpu.models.llama import model as llama_model
+
+    mgr = CheckpointManager(checkpoint_dir)
+    if step is None:
+        step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {checkpoint_dir}")
+    meta = mgr.load_meta(step)
+    mc = dict(meta["model_config"])
+    mc.pop("dtype", None), mc.pop("param_dtype", None)
+    cfg = LlamaConfig(**mc)
+    manifest = StageManifest(**meta["manifest"])
+    template = pl.stack_stages(
+        llama_model.init_params(jax.random.PRNGKey(0), cfg), manifest)
+    params = pl.unstack_stages(mgr.load_params(step, template, manifest), manifest)
+    return params, cfg, manifest, step
+
+
 def _config_meta(cfg: LlamaConfig) -> dict:
     out = {}
     for k, v in dataclasses.asdict(cfg).items():

@@ -1,4 +1,8 @@
 from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig  # noqa: F401
+from llama_pipeline_parallel_tpu.models.llama.decode import (  # noqa: F401
+    GenerationConfig,
+    generate,
+)
 from llama_pipeline_parallel_tpu.models.llama.model import (  # noqa: F401
     forward,
     init_params,

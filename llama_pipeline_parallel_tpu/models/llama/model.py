@@ -132,9 +132,20 @@ def decoder_layer(
         attn_out = tp_reduce(attn_out, tp_axis)
     x = residual + attn_out
 
+    return mlp_block(layer, x, cfg, tp_axis=tp_axis)
+
+
+def mlp_block(layer: Params, x: jnp.ndarray, cfg: LlamaConfig,
+              tp_axis: str | None = None) -> jnp.ndarray:
+    """Post-norm SwiGLU half of a decoder block (shared with the KV-cache
+    decode path, models/llama/decode.py — one implementation, no numerics
+    drift between training and generation)."""
+    dt = cfg.dtype
     residual = x
     hidden = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
     if tp_axis is not None:
+        from llama_pipeline_parallel_tpu.parallel.tp import tp_copy, tp_reduce
+
         hidden = tp_copy(hidden, tp_axis)
     gate = jax.nn.silu(hidden @ layer["mlp"]["gate"].astype(dt))
     up = hidden @ layer["mlp"]["up"].astype(dt)

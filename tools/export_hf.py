@@ -19,31 +19,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def export(checkpoint_dir: str, output_dir: str, step: int | None = None) -> None:
-    import jax
     import torch
     from transformers import LlamaConfig as HFLlamaConfig
     from transformers import LlamaForCausalLM
 
-    from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
-    from llama_pipeline_parallel_tpu.models.llama import model as llama
-    from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import load_module_checkpoint
     from llama_pipeline_parallel_tpu.models.llama.hf import hf_state_dict_from_params
-    from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
-    from llama_pipeline_parallel_tpu.parallel.pipeline import stack_stages, unstack_stages
 
-    mgr = CheckpointManager(checkpoint_dir)
-    if step is None:
-        step = mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint under {checkpoint_dir}")
-    meta = mgr.load_meta(step)
-    mc = dict(meta["model_config"])
-    mc.pop("dtype", None), mc.pop("param_dtype", None)
-    cfg = LlamaConfig(**mc)
-    manifest = StageManifest(**meta["manifest"])
-
-    template = stack_stages(llama.init_params(jax.random.PRNGKey(0), cfg), manifest)
-    params = unstack_stages(mgr.load_params(step, template, manifest), manifest)
+    params, cfg, _, step = load_module_checkpoint(checkpoint_dir, step)
     sd = {k: torch.from_numpy(v) for k, v in
           hf_state_dict_from_params(params, cfg).items()}
 
