@@ -78,6 +78,23 @@ class CheckpointManager:
     def __post_init__(self) -> None:
         os.makedirs(self.root, exist_ok=True)
         self._ckptr = ocp.StandardCheckpointer()
+        self._pending: Any = None  # in-flight async commit thread
+        self._pending_error: Any = None  # exception raised on that thread
+
+    def finalize(self) -> None:
+        """Block until a `save(..., blocking=False)` commit (array flush,
+        meta/tag write, on_complete hook) finishes. No-op when nothing is
+        pending. MUST run before process exit — the commit thread is a
+        daemon precisely so a crash can't hang shutdown, which means clean
+        exits have to wait for it explicitly. Re-raises a failure from the
+        background commit: a failed periodic checkpoint must surface exactly
+        like a failed blocking one, not vanish into a thread traceback."""
+        t, self._pending = self._pending, None
+        if t is not None:
+            t.join()
+        err, self._pending_error = self._pending_error, None
+        if err is not None:
+            raise RuntimeError("async checkpoint commit failed") from err
 
     # -- paths ------------------------------------------------------------
 
@@ -102,12 +119,14 @@ class CheckpointManager:
         """All checkpoint-N step numbers on disk, ascending. Completeness is
         probed on the ACTUAL dirname, so non-canonical spellings (e.g. a
         hand-copied 'checkpoint-007') are still recognized."""
+        self.finalize()  # meta.json of an in-flight async save lands first
         return sorted(int(m.group(1)) for d in os.listdir(self.root)
                       if (m := _CKPT_RE.match(d))
                       and (not complete_only or self._is_complete(d)))
 
     def is_complete(self, step: int) -> bool:
         """Whether checkpoint-<step> finished durably (meta.json present)."""
+        self.finalize()
         for d in os.listdir(self.root):
             m = _CKPT_RE.match(d)
             if m and int(m.group(1)) == step:
@@ -115,6 +134,7 @@ class CheckpointManager:
         return False
 
     def latest_step(self) -> int | None:
+        self.finalize()
         name = self.latest_tag_value()
         if name is not None:
             m = _CKPT_RE.match(name)
@@ -127,12 +147,40 @@ class CheckpointManager:
     # -- save -------------------------------------------------------------
 
     def save(self, step: int, params_stacked: dict, manifest: StageManifest,
-             cfg: LlamaConfig, opt_state: Any | None = None) -> str:
+             cfg: LlamaConfig, opt_state: Any | None = None,
+             blocking: bool = True, on_complete: Any = None) -> str:
         """Save train state (canonical layout) + metadata, update `latest`.
 
         `opt_state=None` produces a module-only checkpoint (the converter's
         output — like reference convert2ckpt.py, which writes no optimizer
-        state either)."""
+        state either).
+
+        `blocking=False` (SURVEY.md §5.3: Orbax ASYNC save): Orbax copies
+        the arrays device-to-host synchronously inside `save` (so the caller
+        may donate/overwrite its buffers immediately), while the disk flush
+        and meta/tag commit run on a background thread — training overlaps
+        the checkpoint's durability tail instead of stalling on it. At most
+        one async commit is in flight: the next save (or `finalize()`) joins
+        the previous one first, re-raising any background failure.
+
+        MULTI-PROCESS runs demote async to blocking: `_commit`'s barrier is
+        a device collective (`sync_global_devices`), and issuing it from the
+        commit thread while the main thread enqueues training collectives
+        gives different processes different collective orders — a pod
+        deadlock. Single-process needs no barrier, so async is safe there.
+
+        `on_complete(path)` runs after the commit (in-thread when async) —
+        the off-node sync hook's slot, so it never sees a half-written dir.
+        """
+        self.finalize()
+        if not blocking and jax.process_count() > 1:
+            if not getattr(self, "_warned_demote", False):
+                self._warned_demote = True
+                logger.warning(
+                    "async save demoted to blocking: %d processes (commit "
+                    "barrier would race training collectives)",
+                    jax.process_count())
+            blocking = True
         path = self.step_dir(step)
         self._ckptr.save(os.path.join(path, "params"),
                          pl.unstack_stages(params_stacked, manifest), force=True)
@@ -140,8 +188,27 @@ class CheckpointManager:
             self._ckptr.save(os.path.join(path, "opt"),
                              _canonicalize_moments(opt_state, manifest, to_canonical=True),
                              force=True)
-        self._commit(path, step, manifest, cfg,
-                     has_optimizer_state=opt_state is not None)
+
+        def commit():
+            self._commit(path, step, manifest, cfg,
+                         has_optimizer_state=opt_state is not None)
+            if on_complete is not None:
+                on_complete(path)
+
+        if blocking:
+            commit()
+        else:
+            import threading
+
+            def guarded():
+                try:
+                    commit()
+                except BaseException as e:  # surfaced by finalize()
+                    self._pending_error = e
+
+            self._pending = threading.Thread(
+                target=guarded, name=f"ckpt-commit-{step}", daemon=True)
+            self._pending.start()
         return path
 
     def save_offload(self, step: int, host, manifest: StageManifest,
@@ -151,6 +218,7 @@ class CheckpointManager:
         extra device HBM is bounded at ONE fp32 tree instead of three (at
         65B the difference between fitting and OOMing: the whole point of
         offload is that p+m+v do NOT fit on device together)."""
+        self.finalize()
         path = self.step_dir(step)
         self._ckptr.save(os.path.join(path, "params"),
                          pl.unstack_stages(host.masters_tree(), manifest),
@@ -195,6 +263,7 @@ class CheckpointManager:
     # -- load -------------------------------------------------------------
 
     def load_meta(self, step: int) -> dict:
+        self.finalize()
         with open(os.path.join(self.step_dir(step), "meta.json")) as f:
             return json.load(f)
 

@@ -98,6 +98,32 @@ class LlamaConfig:
         return LlamaConfig(**base)
 
     @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        """Llama-2 generation: 4k context (GQA only on the 70B size)."""
+        base = dict(max_position_embeddings=4096)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def llama2_13b(**kw) -> "LlamaConfig":
+        base = dict(hidden_size=5120, intermediate_size=13824,
+                    num_hidden_layers=40, num_attention_heads=40,
+                    max_position_embeddings=4096)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def llama2_70b(**kw) -> "LlamaConfig":
+        """GQA flagship: 8 kv heads over 64 query heads — exercises the
+        grouped-KV path (repeat_kv / flash GQA / tp kv constraints) at its
+        production shape."""
+        base = dict(hidden_size=8192, intermediate_size=28672,
+                    num_hidden_layers=80, num_attention_heads=64,
+                    num_key_value_heads=8, max_position_embeddings=4096)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+    @staticmethod
     def from_hf_config(hf_config: Any, **kw) -> "LlamaConfig":
         """Build from a `transformers.LlamaConfig` (the converter entry point,
         replacing reference convert2ckpt.py:56)."""

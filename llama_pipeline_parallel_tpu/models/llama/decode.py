@@ -48,6 +48,11 @@ class GenerationConfig:
     eos_token_id: int | None = None
     pad_token_id: int = 0        # emitted after a row hits eos
 
+    def __post_init__(self) -> None:
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1 (the decode loop "
+                             "always emits the prefill-sampled token)")
+
 
 def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
     """Zeroed static-shape cache. k/v: [n_layers, b, max_len, kv_h, hd]."""
@@ -182,8 +187,14 @@ def generate(params: Params, input_ids: jnp.ndarray, attention_mask: jnp.ndarray
         nxt = jnp.where(done, token, nxt)      # freeze finished rows
         return (cache, nxt, pos + 1, kv_mask, done, rng), out
 
-    carry = (cache, first, next_pos, kv_mask,
-             jnp.zeros((b,), bool), rng)
-    (_, _, _, _, done, _), tokens = jax.lax.scan(
-        step, carry, jnp.arange(gen.max_new_tokens))
+    # Scan T-1 steps: the T-th sampled token needs no forward pass of its
+    # own (nothing consumes its logits), so the final emission happens
+    # outside the loop — at max_new_tokens=1 the decode scan is empty.
+    carry = (cache, first, next_pos, kv_mask, jnp.zeros((b,), bool), rng)
+    (_, token, _, _, done, _), tokens = jax.lax.scan(
+        step, carry, jnp.arange(gen.max_new_tokens - 1))
+    last = jnp.where(done, gen.pad_token_id, token)
+    if gen.eos_token_id is not None:
+        done = done | (token == gen.eos_token_id)
+    tokens = jnp.concatenate([tokens, last[None]], axis=0)
     return {"tokens": tokens.T, "done": done}

@@ -1,0 +1,126 @@
+"""Fused lm-head + cross-entropy, vocab-chunked.
+
+The standard loss path materializes fp32 logits `[tokens, V]` twice (forward
+value + backward softmax) — at V=32k that allocation dominates the loss
+head's HBM traffic and caps the microbatch size (the reference inherits the
+same shape from HF's LlamaForCausalLM loss). This op never builds the full
+logits: it scans over vocab chunks with an online logsumexp (the flash-
+attention trick applied to the classifier), saving only `[tokens]`-sized
+statistics, and recomputes each chunk's logits in the backward to form
+`dh`/`dW` chunk by chunk.
+
+Peak loss-head memory drops from O(tokens x V) to O(tokens x V/chunks);
+compute is unchanged (one extra matmul pass in backward replaces the saved
+logits — exactly what `jax.checkpoint` over the loss already does, so the
+pipeline's remat'd loss gets the memory win for free).
+
+`custom_vjp` because the scan's online-max bookkeeping is numerically exact
+but AD through it would save every chunk's logits — defeating the point.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100
+
+
+def _flatten(h: jnp.ndarray, targets: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    return h.reshape(-1, h.shape[-1]), targets.reshape(-1)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_ce_sum_count(h: jnp.ndarray, w: jnp.ndarray, targets: jnp.ndarray,
+                       num_chunks: int = 8) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(loss_sum fp32, valid count int32) of a fused h @ w classifier.
+
+    h: [..., d] hidden states (compute dtype); w: [d, V]; targets: [...] int
+    labels aligned with h (IGNORE_INDEX = no target). V % num_chunks == 0.
+    """
+    loss_sum, count, _, _ = _forward(h, w, targets, num_chunks)
+    return loss_sum, count
+
+
+def _chunked_w(w: jnp.ndarray, num_chunks: int) -> jnp.ndarray:
+    d, v = w.shape
+    if v % num_chunks:
+        raise ValueError(f"vocab {v} not divisible by num_chunks={num_chunks}")
+    return w.reshape(d, num_chunks, v // num_chunks).transpose(1, 0, 2)
+
+
+def _forward(h, w, targets, num_chunks):
+    hN, tN = _flatten(h, targets)
+    n = hN.shape[0]
+    vc = w.shape[1] // num_chunks
+    wc_stack = _chunked_w(w, num_chunks)  # [C, d, Vc]
+    valid = tN != IGNORE_INDEX
+    safe_t = jnp.where(valid, tN, 0)
+
+    def chunk(carry, xs):
+        m, z, tgt = carry
+        wc, off = xs
+        logits = jnp.einsum("nd,dv->nv", hN, wc,
+                            preferred_element_type=jnp.float32)  # [n, Vc]
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        z = z * jnp.exp(m - m_new) + jnp.exp(
+            logits - m_new[:, None]).sum(axis=-1)
+        li = safe_t - off
+        owned = (li >= 0) & (li < vc)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(li, 0, vc - 1)[:, None], axis=-1)[:, 0]
+        tgt = jnp.where(owned, picked, tgt)
+        return (m_new, z, tgt), None
+
+    offsets = jnp.arange(num_chunks, dtype=jnp.int32) * vc
+    (m, z, tgt), _ = jax.lax.scan(
+        chunk,
+        (jnp.full((n,), -jnp.inf, jnp.float32), jnp.zeros((n,), jnp.float32),
+         jnp.zeros((n,), jnp.float32)),
+        (wc_stack, offsets))
+
+    lse = m + jnp.log(z)
+    loss_sum = jnp.where(valid, lse - tgt, 0.0).sum()
+    return loss_sum, valid.sum(), lse, valid
+
+
+def _fwd(h, w, targets, num_chunks):
+    loss_sum, count, lse, valid = _forward(h, w, targets, num_chunks)
+    return (loss_sum, count), (h, w, targets, lse, valid)
+
+
+def _bwd(num_chunks, res, cts):
+    ct_loss, _ = cts  # count is integer-valued: no cotangent
+    h, w, targets, lse, valid = res
+    hN, tN = _flatten(h, targets)
+    vc = w.shape[1] // num_chunks
+    wc_stack = _chunked_w(w, num_chunks)
+    safe_t = jnp.where(valid, tN, 0)
+    # d(loss_sum)/d(logits) = softmax - onehot, on valid tokens
+    scale = (valid.astype(jnp.float32) * ct_loss)[:, None]
+
+    def chunk(dh, xs):
+        wc, off = xs
+        logits = jnp.einsum("nd,dv->nv", hN, wc,
+                            preferred_element_type=jnp.float32)
+        p = jnp.exp(logits - lse[:, None])
+        li = safe_t - off
+        owned = (li >= 0) & (li < vc)
+        onehot = (jnp.arange(vc)[None, :] == li[:, None]) & owned[:, None]
+        g = ((p - onehot.astype(jnp.float32)) * scale).astype(h.dtype)
+        dh = dh + jnp.einsum("nv,dv->nd", g, wc,
+                             preferred_element_type=jnp.float32)
+        dwc = jnp.einsum("nd,nv->dv", hN, g,
+                         preferred_element_type=jnp.float32)
+        return dh, dwc
+
+    dh, dwc_stack = jax.lax.scan(
+        chunk, jnp.zeros(hN.shape, jnp.float32),
+        (wc_stack, jnp.arange(num_chunks, dtype=jnp.int32) * vc))
+    dw = dwc_stack.transpose(1, 0, 2).reshape(w.shape).astype(w.dtype)
+    return dh.astype(h.dtype).reshape(h.shape), dw, None
+
+
+fused_ce_sum_count.defvjp(_fwd, _bwd)

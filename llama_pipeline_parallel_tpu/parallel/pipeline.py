@@ -117,6 +117,12 @@ class PipelineConfig:
     # layer is collective-free (tp=1, sp=1); with collectives inside, padded
     # slots still compute (they are exact identities either way).
     layer_counts: tuple | None = None
+    # >1: the last stage's lm-head + CE run vocab-chunked with an online
+    # logsumexp (ops/cross_entropy.py) — full [tokens, vocab] fp32 logits are
+    # never materialized, cutting the loss head's peak HBM by ~this factor.
+    # tp>1 already avoids full logits via the vocab-parallel CE; combining
+    # the two is rejected at build time.
+    loss_chunks: int = 1
 
     def __post_init__(self) -> None:
         from llama_pipeline_parallel_tpu.parallel.sp import SP_STRATEGIES
@@ -131,6 +137,8 @@ class PipelineConfig:
             raise ValueError("num_stages must be >= 1")
         if self.schedule not in SCHEDULES:
             raise ValueError(f"unknown schedule {self.schedule!r}; choose one of {SCHEDULES}")
+        if self.loss_chunks < 1:
+            raise ValueError("loss_chunks must be >= 1")
         if self.accum_chunks < 1 or self.num_microbatches % self.accum_chunks:
             raise ValueError(
                 f"accum_chunks={self.accum_chunks} must divide "
@@ -394,6 +402,11 @@ def _pipeline_loss_local(
         if tp_size > 1:
             return _vocab_parallel_token_loss(params, h, targets, cfg,
                                               preshifted=True)
+        if pcfg.loss_chunks > 1:
+            from llama_pipeline_parallel_tpu.ops.cross_entropy import fused_ce_sum_count
+
+            return fused_ce_sum_count(h, params["lm_head"].astype(cfg.dtype),
+                                      targets, pcfg.loss_chunks)
         logits = llama.lm_head(params, h, cfg)
         return llama.token_loss_sum_and_count_preshifted(logits, targets)
 
@@ -568,6 +581,11 @@ def _pipeline_1f1b_local(
             if tp_size > 1:
                 return _vocab_parallel_token_loss({"lm_head": head_w}, h,
                                                   targets, cfg, preshifted=True)[0]
+            if pcfg.loss_chunks > 1:
+                from llama_pipeline_parallel_tpu.ops.cross_entropy import fused_ce_sum_count
+
+                return fused_ce_sum_count(h, head_w.astype(cfg.dtype), targets,
+                                          pcfg.loss_chunks)[0]
             logits = llama.lm_head({"lm_head": head_w}, h, cfg)
             return llama.token_loss_sum_and_count_preshifted(logits, targets)[0]
 
@@ -788,6 +806,15 @@ def make_pipeline_loss_and_grad(
                 f"sequence_parallel=ulysses needs heads/tp divisible by sp: "
                 f"{cfg.num_attention_heads}/{tp} = {local_heads} vs sp={sp} "
                 f"(use sequence_parallel=ring, which has no head constraint)")
+    if pcfg.loss_chunks > 1:
+        if tp > 1:
+            raise ValueError(
+                "loss_chunks > 1 is redundant under tp > 1: the "
+                "vocab-parallel CE already never materializes full logits")
+        if cfg.vocab_size % pcfg.loss_chunks:
+            raise ValueError(
+                f"loss_chunks={pcfg.loss_chunks} must divide "
+                f"vocab_size={cfg.vocab_size}")
     if tp > 1:
         if cfg.kv_heads % tp or cfg.num_attention_heads % tp:
             raise ValueError(

@@ -57,6 +57,9 @@ _PRESETS = {
     "llama_13b": LlamaConfig.llama_13b,
     "llama_33b": LlamaConfig.llama_33b,
     "llama_65b": LlamaConfig.llama_65b,
+    "llama2_7b": LlamaConfig.llama2_7b,
+    "llama2_13b": LlamaConfig.llama2_13b,
+    "llama2_70b": LlamaConfig.llama2_70b,
     "codellama_34b_16k": LlamaConfig.codellama_34b_16k,
 }
 
@@ -241,6 +244,7 @@ def run_training(cfg: dict) -> dict:
         schedule=cfg.get("pipeline_schedule", "1f1b"),
         accum_chunks=cfg.get("gradient_accumulation_chunks", 1),
         sequence_parallel=cfg.get("sequence_parallel", "ring"),
+        loss_chunks=cfg.get("loss_vocab_chunks", 1),
         layer_counts=None if manifest.is_even else manifest.stage_layer_counts)
 
     dataset, collator = build_dataset_and_collator(cfg, model_cfg)
@@ -334,16 +338,32 @@ def run_training(cfg: dict) -> dict:
         return metrics["loss"], lambda: {"lr": float(metrics["lr"]),
                                          "grad_norm": float(metrics["grad_norm"])}
 
-    def do_save(step):
+    def do_save(step, final=False):
+        # async_save: periodic checkpoints return once Orbax holds host
+        # copies; the disk flush + commit + off-node sync overlap the next
+        # training steps. Final/preemption saves block — the process exits
+        # right after, and a daemon commit thread would die with it.
         barrier("pre-save")
-        path = mgr.save(step, state_box[0].params, manifest, model_cfg,
-                        opt_state=state_box[0].opt_state)
-        _sync_checkpoint(cfg, path)
+        mgr.save(step, state_box[0].params, manifest, model_cfg,
+                 opt_state=state_box[0].opt_state,
+                 blocking=final or not cfg.get("async_save", False),
+                 on_complete=lambda path: _sync_checkpoint(cfg, path))
 
     do_eval = _make_evaluator(cfg, mesh, model_cfg, pcfg, stacked_template,
                               attn_fn, lambda: state_box[0].params)
-    final_loss = _train_loop(cfg, model_cfg, mesh, loader, seq_length,
-                             resume_step, end_step, do_step, do_save, do_eval)
+    try:
+        final_loss = _train_loop(cfg, model_cfg, mesh, loader, seq_length,
+                                 resume_step, end_step, do_step, do_save, do_eval)
+    except BaseException:
+        # join the in-flight commit, but never let ITS failure replace the
+        # training exception that actually killed the run
+        try:
+            mgr.finalize()
+        except Exception:
+            logger.exception("async checkpoint commit also failed while "
+                             "unwinding a training error")
+        raise
+    mgr.finalize()  # surface any async-commit failure on the clean path
     return {"final_step": end_step, "final_loss": final_loss,
             "steps_per_epoch": steps_per_epoch, "output_dir": output_dir}
 
@@ -478,7 +498,7 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
             if check_now and _should_stop(bool(stop_signal)):
                 logger.warning("preemption signal; checkpointing at step %d and "
                                "exiting for clean resume", step)
-                do_save(step)
+                do_save(step, final=True)
                 last_saved = end_step  # suppress the save_final duplicate
                 break
             if profile_window and not trace_active and step >= profile_window[0] \
@@ -513,7 +533,7 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
             signal.signal(sig, handler)
         writer.close()
     if cfg.get("save_final", True) and last_saved != end_step:
-        do_save(end_step)
+        do_save(end_step, final=True)
     return final_loss
 
 
@@ -619,7 +639,9 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
                               **{k: round(v, 2)
                                  for k, v in host.last_timings.items()}}
 
-    def do_save(step):
+    def do_save(step, final=False):
+        # the offload save streams from host masters that the next optimizer
+        # step mutates IN PLACE — it must block regardless of async_save
         barrier("pre-save")
         path = mgr.save_offload(step, host, manifest, model_cfg)
         _sync_checkpoint(cfg, path)

@@ -60,6 +60,47 @@ def test_full_roundtrip_same_topology(tmp_path, cfg, devices):
     tree_equal(opt2, state.opt_state)
 
 
+def test_async_save_finalize_and_roundtrip(tmp_path, cfg, devices):
+    """blocking=False: commit (meta/tag/on_complete) lands after finalize();
+    back-to-back async saves serialize; the result round-trips bit-exactly."""
+    state, manifest, tx = _trained_state(cfg, pp=2, dp=2)
+    mgr = CheckpointManager(str(tmp_path))
+    seen = []
+    mgr.save(2, state.params, manifest, cfg, opt_state=state.opt_state,
+             blocking=False, on_complete=seen.append)
+    mgr.finalize()
+    assert seen == [mgr.step_dir(2)]
+    assert mgr.is_complete(2) and mgr.latest_step() == 2
+    params2, opt2, step = mgr.load(2, state.params, state.opt_state, manifest)
+    assert step == 2
+    tree_equal(params2, state.params)
+    tree_equal(opt2, state.opt_state)
+
+    mgr.save(3, state.params, manifest, cfg, blocking=False)
+    mgr.save(4, state.params, manifest, cfg, blocking=False)  # joins save(3)
+    mgr.finalize()
+    assert mgr.is_complete(3) and mgr.is_complete(4)
+    assert mgr.latest_step() == 4
+
+
+def test_async_save_surfaces_commit_failure(tmp_path, cfg, devices):
+    """A background-commit failure must fail the run at finalize(), exactly
+    as a blocking save would — not vanish into a daemon-thread traceback."""
+    manifest = StageManifest.for_config(cfg, 1)
+    stacked = pl.stack_stages(llama.init_params(jax.random.PRNGKey(0), cfg),
+                              manifest)
+    mgr = CheckpointManager(str(tmp_path))
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    mgr._commit = boom
+    mgr.save(2, stacked, manifest, cfg, blocking=False)
+    with pytest.raises(RuntimeError, match="async checkpoint commit failed"):
+        mgr.finalize()
+    mgr.finalize()  # error is consumed; manager stays usable
+
+
 def test_topology_change_restore(tmp_path, cfg, devices):
     """Save at PP=2, restore at PP=4 — forbidden by the reference's filename
     arithmetic, enabled by the canonical layout + manifest design."""

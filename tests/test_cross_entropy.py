@@ -1,0 +1,117 @@
+"""Fused vocab-chunked cross-entropy (ops/cross_entropy.py): exactness of
+value and gradients against the materialized-logits reference, and parity
+inside both pipeline schedules via the `loss_chunks` knob."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llama_pipeline_parallel_tpu.models.llama import model as llama
+from llama_pipeline_parallel_tpu.ops.cross_entropy import fused_ce_sum_count
+
+
+def _inputs(n=6, s=10, d=16, v=32, seed=0):
+    r = np.random.RandomState(seed)
+    h = jnp.asarray(r.randn(n, s, d).astype(np.float32))
+    w = jnp.asarray(r.randn(d, v).astype(np.float32) * 0.1)
+    t = r.randint(0, v, (n, s))
+    t[:, -2:] = llama.IGNORE_INDEX  # some untargeted positions
+    t[0, 0] = llama.IGNORE_INDEX
+    return h, w, jnp.asarray(t, jnp.int32)
+
+
+def _reference(h, w, t):
+    logits = (h @ w).astype(jnp.float32)
+    return llama.token_loss_sum_and_count_preshifted(logits, t)
+
+
+@pytest.mark.parametrize("chunks", [1, 2, 8])
+def test_value_and_count_match_reference(chunks):
+    h, w, t = _inputs()
+    want_sum, want_count = _reference(h, w, t)
+    got_sum, got_count = fused_ce_sum_count(h, w, t, chunks)
+    np.testing.assert_allclose(got_sum, want_sum, rtol=1e-6)
+    assert int(got_count) == int(want_count)
+
+
+def test_gradients_match_reference():
+    h, w, t = _inputs()
+
+    def ref(h_, w_):
+        return _reference(h_, w_, t)[0]
+
+    def fused(h_, w_):
+        return fused_ce_sum_count(h_, w_, t, 4)[0]
+
+    dref = jax.grad(ref, argnums=(0, 1))(h, w)
+    dfused = jax.grad(fused, argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(dfused[0], dref[0], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(dfused[1], dref[1], rtol=1e-5, atol=1e-6)
+
+
+def test_all_tokens_ignored_is_finite():
+    h, w, _ = _inputs()
+    t = jnp.full(h.shape[:2], llama.IGNORE_INDEX, jnp.int32)
+    s, c = fused_ce_sum_count(h, w, t, 4)
+    assert float(s) == 0.0 and int(c) == 0
+    g = jax.grad(lambda h_: fused_ce_sum_count(h_, w, t, 4)[0])(h)
+    assert np.isfinite(np.asarray(g)).all() and float(jnp.abs(g).sum()) == 0.0
+
+
+def test_indivisible_vocab_rejected():
+    h, w, t = _inputs(v=30)
+    with pytest.raises(ValueError, match="not divisible"):
+        fused_ce_sum_count(h, w, t, 4)
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+def test_pipeline_loss_chunks_parity(devices, schedule):
+    """loss AND grads identical with/without the fused loss head at PP=2."""
+    from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+    from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+    from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+    from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg = LlamaConfig.tiny()
+    mesh = make_mesh(MeshConfig(pp=2))
+    manifest = StageManifest.for_config(cfg, 2)
+    stacked = pl.stack_stages(llama.init_params(jax.random.PRNGKey(0), cfg), manifest)
+
+    r = np.random.RandomState(1)
+    bsz, seq = 4, 16
+    ids = r.randint(3, cfg.vocab_size, (bsz, seq)).astype(np.int32)
+    batch = {
+        "input_ids": jnp.asarray(ids),
+        "attention_mask": jnp.ones((bsz, seq), jnp.int32),
+        "position_ids": jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32), (bsz, seq)),
+        "labels": jnp.asarray(ids),
+    }
+
+    losses, grads = [], []
+    for chunks in (1, 4):
+        pcfg = pl.PipelineConfig(num_stages=2, num_microbatches=2,
+                                 schedule=schedule, loss_chunks=chunks)
+        fn = jax.jit(pl.make_pipeline_loss_and_grad(mesh, cfg, pcfg, stacked))
+        l, g = fn(stacked, batch)
+        losses.append(float(l))
+        grads.append(g)
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(grads[0]), jax.tree.leaves(grads[1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_loss_chunks_with_tp_rejected(devices):
+    from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+    from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+    from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+    from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg = LlamaConfig.tiny()
+    mesh = make_mesh(MeshConfig(pp=2, tp=2))
+    manifest = StageManifest.for_config(cfg, 2)
+    stacked = pl.stack_stages(llama.init_params(jax.random.PRNGKey(0), cfg), manifest)
+    pcfg = pl.PipelineConfig(num_stages=2, num_microbatches=2, loss_chunks=2)
+    with pytest.raises(ValueError, match="redundant under tp"):
+        pl.make_pipeline_loss_and_grad(mesh, cfg, pcfg, stacked)

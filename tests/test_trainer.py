@@ -66,6 +66,24 @@ def test_resume_continues_identically(tmp_path, devices):
     np.testing.assert_allclose(resumed["final_loss"], straight["final_loss"], rtol=1e-6)
 
 
+def test_async_save_loop_durable_and_resumable(tmp_path, devices):
+    """async_save: periodic checkpoints commit in the background but are
+    durable by loop exit, and a resumed run picks the latest one up."""
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
+
+    cfg = base_cfg(tmp_path, save_steps=2, async_save=True, max_steps=4,
+                   total_steps=8)
+    out = run_training(cfg)["output_dir"]
+    mgr = CheckpointManager(out)
+    assert mgr.list_steps(complete_only=True) == [2, 4]
+    assert mgr.latest_step() == 4
+
+    resumed = run_training(base_cfg(tmp_path, save_steps=2, async_save=True,
+                                    max_steps=8))
+    assert resumed["final_step"] == 8
+    assert CheckpointManager(out).latest_step() == 8
+
+
 def test_warm_start_requires_checkpoint(tmp_path, devices):
     cfg = base_cfg(tmp_path, model_name_or_path=str(tmp_path / "missing"), resume=False)
     with pytest.raises(FileNotFoundError, match="convert_hf"):
@@ -111,7 +129,27 @@ def test_eval_loop(tmp_path, devices):
 
 
 def test_shipped_configs_parse():
-    for name in ("tiny_smoke", "llama_7b_pp4", "llama_65b_pp8_dp4"):
-        cfg = load_config(f"conf/{name}.yaml")
-        assert isinstance(cfg["learning_rate"], float)
-        assert cfg["mesh"]["pp"] >= 1
+    """EVERY shipped config must parse, build its model config, and satisfy
+    the mesh divisibility rules the runtime enforces (tp over heads/kv/ffn/
+    vocab, sp over the sequence) — a new yaml cannot ship broken."""
+    import glob
+
+    from llama_pipeline_parallel_tpu.train import build_model_config
+
+    conf_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "conf")
+    paths = sorted(glob.glob(os.path.join(conf_dir, "*.yaml")))
+    assert len(paths) >= 5
+    for path in paths:
+        cfg = load_config(path)
+        assert isinstance(cfg["learning_rate"], float), path
+        mesh = cfg.get("mesh", {})
+        assert mesh.get("pp", 1) >= 1, path
+        mc = build_model_config(cfg["model"])
+        tp, sp = mesh.get("tp", 1), mesh.get("sp", 1)
+        assert mc.num_attention_heads % tp == 0, path
+        assert mc.kv_heads % tp == 0, path
+        assert mc.intermediate_size % tp == 0, path
+        assert mc.vocab_size % tp == 0, path
+        assert cfg.get("max_seq_length", 512) % sp == 0, path
+        assert mc.num_hidden_layers >= mesh.get("pp", 1), path
