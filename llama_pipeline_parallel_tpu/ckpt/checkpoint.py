@@ -146,9 +146,33 @@ class CheckpointManager:
 
     # -- save -------------------------------------------------------------
 
+    def prune(self, keep_last: int) -> list[int]:
+        """Delete the oldest COMPLETE checkpoints beyond the newest
+        `keep_last` (disk-retention policy, process 0 only on shared
+        storage). Incomplete dirs are left alone — they are either mid-write
+        or already ignored by every reader. Returns the pruned steps."""
+        import shutil
+
+        if keep_last < 1:
+            raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+        if jax.process_index() != 0:
+            return []
+        # raw listing, NOT list_steps(): prune runs on the async commit
+        # thread, and list_steps' finalize() would join the current thread.
+        # Deletion goes by the ACTUAL dirname, so non-canonical spellings
+        # ('checkpoint-007') are pruned too, not step_dir() reconstructions.
+        complete = sorted((int(m.group(1)), d) for d in os.listdir(self.root)
+                          if (m := _CKPT_RE.match(d)) and self._is_complete(d))
+        doomed = complete[:-keep_last]
+        for s, dirname in doomed:
+            shutil.rmtree(os.path.join(self.root, dirname), ignore_errors=True)
+            logger.info("pruned %s (save_total_limit=%d)", dirname, keep_last)
+        return [s for s, _ in doomed]
+
     def save(self, step: int, params_stacked: dict, manifest: StageManifest,
              cfg: LlamaConfig, opt_state: Any | None = None,
-             blocking: bool = True, on_complete: Any = None) -> str:
+             blocking: bool = True, on_complete: Any = None,
+             keep_last: int | None = None) -> str:
         """Save train state (canonical layout) + metadata, update `latest`.
 
         `opt_state=None` produces a module-only checkpoint (the converter's
@@ -194,6 +218,8 @@ class CheckpointManager:
                          has_optimizer_state=opt_state is not None)
             if on_complete is not None:
                 on_complete(path)
+            if keep_last:  # None/0 both mean "no retention limit"
+                self.prune(keep_last)
 
         if blocking:
             commit()
@@ -212,12 +238,15 @@ class CheckpointManager:
         return path
 
     def save_offload(self, step: int, host, manifest: StageManifest,
-                     cfg: LlamaConfig) -> str:
+                     cfg: LlamaConfig, keep_last: int | None = None) -> str:
         """Streamed save for the host-offloaded optimizer: params, then m,
         then v, each assembled-and-written before the next is assembled —
         extra device HBM is bounded at ONE fp32 tree instead of three (at
         65B the difference between fitting and OOMing: the whole point of
-        offload is that p+m+v do NOT fit on device together)."""
+        offload is that p+m+v do NOT fit on device together).
+
+        `keep_last`: same retention semantics as save() (prune after
+        commit; None/0 disable)."""
         self.finalize()
         path = self.step_dir(step)
         self._ckptr.save(os.path.join(path, "params"),
@@ -232,6 +261,8 @@ class CheckpointManager:
         self._commit(path, step, manifest, cfg, has_optimizer_state=True,
                      opt_layout="offload_parts",
                      opt_step_count=int(host.step_count))
+        if keep_last:
+            self.prune(keep_last)
         return path
 
     def _commit(self, path: str, step: int, manifest: StageManifest,

@@ -347,7 +347,8 @@ def run_training(cfg: dict) -> dict:
         mgr.save(step, state_box[0].params, manifest, model_cfg,
                  opt_state=state_box[0].opt_state,
                  blocking=final or not cfg.get("async_save", False),
-                 on_complete=lambda path: _sync_checkpoint(cfg, path))
+                 on_complete=lambda path: _sync_checkpoint(cfg, path),
+                 keep_last=cfg.get("save_total_limit"))
 
     do_eval = _make_evaluator(cfg, mesh, model_cfg, pcfg, stacked_template,
                               attn_fn, lambda: state_box[0].params)
@@ -643,7 +644,8 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
         # the offload save streams from host masters that the next optimizer
         # step mutates IN PLACE — it must block regardless of async_save
         barrier("pre-save")
-        path = mgr.save_offload(step, host, manifest, model_cfg)
+        path = mgr.save_offload(step, host, manifest, model_cfg,
+                                keep_last=cfg.get("save_total_limit"))
         _sync_checkpoint(cfg, path)
 
     do_eval = _make_evaluator(cfg, mesh, model_cfg, pcfg, stacked_template,

@@ -83,6 +83,30 @@ def test_async_save_finalize_and_roundtrip(tmp_path, cfg, devices):
     assert mgr.latest_step() == 4
 
 
+def test_prune_keeps_newest_and_ignores_incomplete(tmp_path, cfg, devices):
+    """save_total_limit semantics: oldest COMPLETE checkpoints beyond the
+    limit are deleted (incl. async path via keep_last=); incomplete dirs and
+    the latest tag are untouched."""
+    manifest = StageManifest.for_config(cfg, 1)
+    stacked = pl.stack_stages(llama.init_params(jax.random.PRNGKey(0), cfg),
+                              manifest)
+    mgr = CheckpointManager(str(tmp_path))
+    for s in (2, 3):
+        mgr.save(s, stacked, manifest, cfg)
+    os.makedirs(mgr.step_dir(0))  # incomplete (no meta.json): never pruned
+    # complete checkpoint under a NON-canonical dirname (hand-copied style):
+    # pruning must delete the actual directory, not a step_dir() respelling
+    odd = str(tmp_path / "checkpoint-001")
+    os.makedirs(odd)
+    open(os.path.join(odd, "meta.json"), "w").write("{}")
+    mgr.save(4, stacked, manifest, cfg, blocking=False, keep_last=2)
+    mgr.finalize()
+    assert mgr.list_steps(complete_only=True) == [3, 4]
+    assert not os.path.isdir(odd)
+    assert os.path.isdir(mgr.step_dir(0))
+    assert mgr.latest_step() == 4
+
+
 def test_async_save_surfaces_commit_failure(tmp_path, cfg, devices):
     """A background-commit failure must fail the run at finalize(), exactly
     as a blocking save would — not vanish into a daemon-thread traceback."""
