@@ -14,6 +14,11 @@ all [batch, seq]. The first pipeline stage consumes ids/mask/positions, the
 last stage consumes labels — matching the reference's
 `((input_ids, attention_mask, position_ids), labels)` tuple split
 (reference data/flan.py:304-307) without the tuple plumbing.
+
+`attention_mask` carries SEGMENT IDS, not just 0/1: 0 = pad, nonzero = real.
+The plain collators emit all-1 masks; PackedCausalLMCollator numbers each
+packed example 1..k so the attention op can mask cross-segment pairs
+(ops/attention.py).
 """
 
 from __future__ import annotations
@@ -26,30 +31,39 @@ import numpy as np
 IGNORE_INDEX = -100  # reference data/flan.py:187
 
 
+def causal_texts(inputs: Sequence[str], targets: Sequence[str], eos: str) -> list[str]:
+    """The ONE place the `input + " " + target + eos` join lives — packed and
+    unpacked collators must tokenize identically or their labels drift."""
+    return [f"{inp} {tgt}{eos}" for inp, tgt in zip(inputs, targets)]
+
+
+def prompt_lengths(tokenizer: Any, inputs: Sequence[str], max_seq_length: int
+                   ) -> np.ndarray:
+    """Token count of each bare prompt — the reference's double-tokenize
+    trick (`vanilla_seq2seq_convertor`, data/flan.py:149-170): tokenize the
+    prompt alone to learn how many combined-text tokens to mask. The only
+    robust method across subword tokenizers."""
+    enc = tokenizer(list(inputs), max_length=max_seq_length, truncation=True,
+                    return_length=True)
+    return np.asarray([len(x) for x in enc["input_ids"]], np.int32)
+
+
 def seq2seq_to_causal(
     inputs: Sequence[str],
     targets: Sequence[str],
     tokenizer: Any,
     max_seq_length: int,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Tokenize `input + " " + target + eos` pairs for decoder-only training.
-
-    The reference's `vanilla_seq2seq_convertor` (data/flan.py:149-170)
-    double-tokenizes: once for the combined text and once for the prompt alone
-    to find how many tokens to mask. Same approach here (it is the only
-    robust way across tokenizers), vectorized over the batch.
+    """Tokenize seq2seq pairs for decoder-only training.
 
     Returns (input_ids, attention_mask, prompt_lens), right-padded.
     """
-    texts = [f"{inp} {tgt}{tokenizer.eos_token}" for inp, tgt in zip(inputs, targets)]
-    enc = tokenizer(list(texts), max_length=max_seq_length, truncation=True,
+    texts = causal_texts(inputs, targets, tokenizer.eos_token)
+    enc = tokenizer(texts, max_length=max_seq_length, truncation=True,
                     padding="max_length", return_tensors="np")
-    prompt_enc = tokenizer(list(inputs), max_length=max_seq_length, truncation=True,
-                           return_length=True)
-    prompt_lens = np.asarray([len(x) for x in prompt_enc["input_ids"]], np.int32)
     return (enc["input_ids"].astype(np.int32),
             enc["attention_mask"].astype(np.int32),
-            prompt_lens)
+            prompt_lengths(tokenizer, inputs, max_seq_length))
 
 
 def get_lm_labels(input_ids: np.ndarray, attention_mask: np.ndarray,
@@ -91,6 +105,92 @@ class CausalLMCollator:
         return {
             "input_ids": input_ids,
             "attention_mask": attention_mask,
+            "position_ids": position_ids,
+            "labels": labels,
+        }
+
+
+@dataclasses.dataclass
+class PackedCausalLMCollator:
+    """Sequence packing: several (inputs, targets) examples share one
+    max_seq_length row instead of each paying its own padding.
+
+    The reference pads every example to 512 tokens (reference conf yaml:32,
+    data/flan.py:264-268) — on short FLAN-style examples most of every batch
+    is pad compute. Packing recovers it:
+    - `attention_mask` carries SEGMENT IDS (1..k within a row, 0 = pad); the
+      attention op masks cross-segment pairs in self-attention, so packed
+      examples never see each other (ops/attention.py).
+    - `position_ids` restart at 0 for each segment (rope stays per-example).
+    - Label safety: the first token of every segment is ALWAYS IGNORE_INDEX
+      (the prompt span normally covers it; it is forced even for an
+      empty-tokenizing prompt) — the previous segment's final position takes
+      its shifted target from that slot and must contribute no loss.
+
+    Called with N examples it emits N // pack_factor rows (a FIXED shape for
+    jit), first-fit in arrival order; examples that fit no remaining row are
+    dropped and counted in `dropped_total`. Choose pack_factor ~= the mean
+    per-example padding ratio (e.g. 4 when examples average ~128 tokens at
+    max_seq_length=512).
+    """
+
+    tokenizer: Any
+    max_seq_length: int
+    pack_factor: int = 4
+
+    def __post_init__(self) -> None:
+        if self.pack_factor < 1:
+            raise ValueError(f"pack_factor must be >= 1, got {self.pack_factor}")
+        self.dropped_total = 0
+
+    def __call__(self, examples: Sequence[Mapping[str, str]]) -> dict[str, np.ndarray]:
+        inputs = [ex["inputs"] for ex in examples]
+        texts = causal_texts(inputs, [ex["targets"] for ex in examples],
+                             self.tokenizer.eos_token)
+        enc = self.tokenizer(texts, max_length=self.max_seq_length,
+                             truncation=True)
+        prompt_lens = prompt_lengths(self.tokenizer, inputs, self.max_seq_length)
+
+        rows = max(len(examples) // self.pack_factor, 1)
+        L = self.max_seq_length
+        input_ids = np.zeros((rows, L), np.int32)
+        segment_ids = np.zeros((rows, L), np.int32)
+        position_ids = np.zeros((rows, L), np.int32)
+        labels = np.full((rows, L), IGNORE_INDEX, np.int32)
+        cursor = np.zeros(rows, np.int32)
+        seg_count = np.zeros(rows, np.int32)
+
+        dropped = 0
+        for ids, prompt_len in zip(enc["input_ids"], prompt_lens):
+            n = len(ids)
+            row = next((r for r in range(rows) if cursor[r] + n <= L), None)
+            if row is None:
+                dropped += 1
+                continue
+            at = int(cursor[row])
+            seg_count[row] += 1
+            input_ids[row, at:at + n] = ids
+            segment_ids[row, at:at + n] = seg_count[row]
+            position_ids[row, at:at + n] = np.arange(n)
+            # mask the prompt span, and ALWAYS the segment's first token even
+            # if the prompt tokenized to zero tokens — the previous segment's
+            # last position takes its shifted target from this slot, and must
+            # never be trained against another example's content
+            start = max(min(int(prompt_len), n), 1)
+            labels[row, at + start:at + n] = ids[start:]
+            cursor[row] += n
+        if dropped:
+            self.dropped_total += dropped
+            if self.dropped_total == dropped:  # first time: make it visible
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "packing dropped %d example(s) that fit no row; lower "
+                    "pack_factor or raise max_seq_length if this persists",
+                    dropped)
+        return {
+            "input_ids": input_ids,
+            "attention_mask": segment_ids,
             "position_ids": position_ids,
             "labels": labels,
         }

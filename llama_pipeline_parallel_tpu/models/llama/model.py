@@ -253,8 +253,10 @@ def forward(
 
     Batch protocol matches the reference collator output
     `(input_ids, attention_mask, position_ids)` (reference data/flan.py:304-307)
-    with `attention_mask` as a per-token [b, s] 0/1 mask, NOT a materialized
-    [b, 1, L, L] tensor (SURVEY.md §3.5 fix).
+    with `attention_mask` as per-token [b, s] SEGMENT IDS (0 = pad; packed
+    batches number each example 1..k and attention masks cross-segment
+    pairs; plain batches use all-1s) — NOT a materialized [b, 1, L, L]
+    tensor (SURVEY.md §3.5 fix). See ops/attention.py.
     """
     b, s = input_ids.shape
     if position_ids is None:

@@ -38,10 +38,18 @@ def attention(
 ) -> jnp.ndarray:
     """Exact attention. q: [b, sq, h, hd]; k/v: [b, skv, h_kv, hd].
 
-    padding_mask: [b, skv] with 1 = real token, 0 = pad (the collator's 1-D
-    mask — never a materialized [L, L] tensor).
+    padding_mask: [b, skv] SEGMENT IDS: 0 = pad, nonzero = real token. The
+    plain collator emits all-1 masks (the reference's 0/1 semantics,
+    data/flan.py) — but a packed batch numbers each packed example 1..k
+    (data/collator.py PackedCausalLMCollator), and self-attention (sq == skv)
+    additionally masks PAIRS from different segments, so packed examples
+    never attend across their boundaries. With a 0/1 mask the segment test
+    is vacuous on real-real pairs, making this a strict generalization.
+    Never a materialized [L, L] tensor either way.
     q_offset/kv_offset: global positions of the local q/kv blocks, used by the
-    ring-attention caller where each sp shard holds a sequence slice.
+    ring-attention caller where each sp shard holds a sequence slice (ring
+    rotation breaks sq == skv pairing with the LOCAL mask, so packing is
+    gated to sp=1 by the trainer).
     """
     b, sq, h, hd = q.shape
     n_rep = h // k.shape[2]
@@ -60,7 +68,16 @@ def attention(
         causal_ok = q_pos[:, None] >= kv_pos[None, :]  # [sq, skv]
         scores = jnp.where(causal_ok[None, None], scores, NEG_INF)
     if padding_mask is not None:
-        scores = jnp.where(padding_mask[:, None, None, :].astype(bool), scores, NEG_INF)
+        ok = padding_mask[:, None, None, :].astype(bool)  # kv is not pad
+        if sq == k.shape[1]:
+            # self-attention: q and kv share the mask row, so segment ids
+            # pair up positionally — cross-segment pairs are masked (no-op
+            # for 0/1 masks: real-real pairs always share the value 1; the
+            # all-masked rows this creates at PAD q positions soften to a
+            # uniform softmax, and nothing downstream reads pad positions)
+            seg = padding_mask.astype(jnp.int32)
+            ok = ok & (seg[:, None, :, None] == seg[:, None, None, :])
+        scores = jnp.where(ok, scores, NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)

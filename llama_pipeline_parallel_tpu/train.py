@@ -25,7 +25,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
-from llama_pipeline_parallel_tpu.data.collator import CausalLMCollator, PretokenizedCollator
+from llama_pipeline_parallel_tpu.data.collator import (
+    CausalLMCollator,
+    PackedCausalLMCollator,
+    PretokenizedCollator,
+)
 from llama_pipeline_parallel_tpu.data.datasets import SyntheticDataset
 from llama_pipeline_parallel_tpu.data.loader import (
     DataLoader,
@@ -77,9 +81,20 @@ def build_model_config(node: dict) -> LlamaConfig:
     return LlamaConfig(**node)
 
 
+def _packing_factor(cfg: dict) -> int:
+    """The one place packing_factor is parsed (train + eval + collator
+    construction must agree on it)."""
+    return int(cfg.get("packing_factor", 1) or 1)
+
+
 def build_dataset_and_collator(cfg: dict, model_cfg: LlamaConfig) -> tuple[Any, Any]:
+    packing = _packing_factor(cfg)
     data_cfg = cfg.get("dataset")
     if data_cfg is None or data_cfg.get("synthetic"):
+        if packing > 1:
+            raise ValueError("packing_factor requires a tokenizer-backed "
+                             "dataset (the synthetic dataset emits fixed "
+                             "full-length rows — nothing to pack)")
         seq = (data_cfg or {}).get("seq_length", cfg.get("max_seq_length", 512))
         ds = SyntheticDataset(
             vocab_size=model_cfg.vocab_size, seq_length=seq,
@@ -90,6 +105,10 @@ def build_dataset_and_collator(cfg: dict, model_cfg: LlamaConfig) -> tuple[Any, 
     ds = instantiate(data_cfg)
     coll_cfg = cfg.get("collator")
     if coll_cfg is not None and "_target_" in coll_cfg:
+        if packing > 1:
+            raise ValueError("packing_factor cannot be combined with a "
+                             "custom collator _target_; construct "
+                             "PackedCausalLMCollator there directly")
         collator = instantiate(coll_cfg)
     else:
         from transformers import AutoTokenizer
@@ -104,7 +123,11 @@ def build_dataset_and_collator(cfg: dict, model_cfg: LlamaConfig) -> tuple[Any, 
                 f"{model_cfg.vocab_size}; re-convert the checkpoint with vocab "
                 f"expansion (tools/convert_hf.py resizes embeddings, like "
                 f"reference convert2ckpt.py:60-63)")
-        collator = CausalLMCollator(tokenizer, cfg.get("max_seq_length", 512))
+        if packing > 1:
+            collator = PackedCausalLMCollator(
+                tokenizer, cfg.get("max_seq_length", 512), pack_factor=packing)
+        else:
+            collator = CausalLMCollator(tokenizer, cfg.get("max_seq_length", 512))
     return ds, collator
 
 
@@ -247,9 +270,28 @@ def run_training(cfg: dict) -> dict:
         loss_chunks=cfg.get("loss_vocab_chunks", 1),
         layer_counts=None if manifest.is_even else manifest.stage_layer_counts)
 
+    packing = _packing_factor(cfg)
+    if packing > 1:
+        if mesh_cfg.sp > 1:
+            raise ValueError(
+                "packing_factor requires sp=1: the ring path drops the "
+                "padding mask entirely (parallel/sp.py passes None — segment "
+                "ids would be silently discarded, letting packed examples "
+                "attend across boundaries), and the Ulysses path, though it "
+                "all-gathers the mask, is unvalidated with segment ids")
+        if cfg.get("attention", "auto") == "flash":
+            raise ValueError(
+                "packing_factor requires exact attention: the flash kernel "
+                "has no segment mask — packed examples would attend across "
+                "their boundaries")
+        if cfg.get("attention", "auto") != "exact":
+            logger.info("packing_factor=%d forces attention=exact "
+                        "(segment masking lives in the exact op)", packing)
+            cfg = {**cfg, "attention": "exact"}
     dataset, collator = build_dataset_and_collator(cfg, model_cfg)
     micro_batch = cfg.get("per_device_train_batch_size", 1)
-    per_replica_batch = micro_batch * pcfg.num_microbatches
+    # with packing, the loader feeds pack_factor x examples per emitted row
+    per_replica_batch = micro_batch * pcfg.num_microbatches * packing
     loader = DataLoader(dataset, collator, per_replica_batch=per_replica_batch,
                         dp_size=mesh_cfg.dp, seed=seed,
                         dp_range=host_dp_shard(mesh))
@@ -408,8 +450,10 @@ def _make_evaluator(cfg, mesh, model_cfg, pcfg, stacked_template, attn_fn,
     eval_ds, eval_coll = build_dataset_and_collator(
         {**cfg, "dataset": eval_cfg}, model_cfg)
     mesh_dp = mesh.shape["dp"]
-    per_replica = cfg.get("per_device_eval_batch_size",
-                          cfg.get("per_device_train_batch_size", 1)) * pcfg.num_microbatches
+    per_replica = (cfg.get("per_device_eval_batch_size",
+                           cfg.get("per_device_train_batch_size", 1))
+                   * pcfg.num_microbatches
+                   * _packing_factor(cfg))
     eval_loader = DataLoader(eval_ds, eval_coll, per_replica_batch=per_replica,
                              dp_size=mesh_dp, shuffle=False,
                              dp_range=host_dp_shard(mesh))
