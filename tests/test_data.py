@@ -14,6 +14,7 @@ from llama_pipeline_parallel_tpu.data.collator import (
 from llama_pipeline_parallel_tpu.data.datasets import (
     ConcatDataset,
     JsonSeq2SeqDataset,
+    LazyJsonlDataset,
     MixtureDataset,
     SyntheticDataset,
 )
@@ -77,6 +78,38 @@ def test_json_dataset_and_concat(tmp_path):
     assert len(cat) == 2 and cat[1]["inputs"] == "i3"
     with pytest.raises(IndexError):
         cat[2]
+
+
+def test_lazy_jsonl_matches_eager(tmp_path):
+    """LazyJsonlDataset is an access-for-access drop-in for the eager
+    JsonSeq2SeqDataset: same filtering, same records, any access order,
+    concurrent reads from multiple threads."""
+    p = tmp_path / "corpus.jsonl"
+    with open(p, "w") as f:
+        for i in range(20):
+            f.write(json.dumps({"inputs": f"in {i}",
+                                "targets": "" if i % 5 == 0 else f"out {i}"}) + "\n")
+        f.write("\n")  # blank line tolerated
+    eager = JsonSeq2SeqDataset(str(p))
+    lazy = LazyJsonlDataset(str(p))
+    assert len(lazy) == len(eager) == 16
+    for idx in [15, 0, 7, 7, 3]:  # arbitrary order, repeats
+        assert lazy[idx] == eager[idx]
+
+    import concurrent.futures
+
+    with concurrent.futures.ThreadPoolExecutor(4) as ex:
+        rows = list(ex.map(lambda i: lazy[i], range(16)))
+    assert rows == [eager[i] for i in range(16)]
+
+    # custom field names: both datasets must filter on the SAME field
+    q = tmp_path / "fields.jsonl"
+    with open(q, "w") as f:
+        f.write(json.dumps({"q": "a", "r": "keep"}) + "\n")
+        f.write(json.dumps({"q": "b", "r": ""}) + "\n")
+    for cls in (JsonSeq2SeqDataset, LazyJsonlDataset):
+        d = cls(str(q), input_field="q", target_field="r")
+        assert len(d) == 1 and d[0] == {"inputs": "a", "targets": "keep"}
 
 
 def test_sharded_sampler_partition_and_epochs():
