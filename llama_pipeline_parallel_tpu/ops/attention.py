@@ -47,9 +47,10 @@ def attention(
     is vacuous on real-real pairs, making this a strict generalization.
     Never a materialized [L, L] tensor either way.
     q_offset/kv_offset: global positions of the local q/kv blocks, used by the
-    ring-attention caller where each sp shard holds a sequence slice (ring
-    rotation breaks sq == skv pairing with the LOCAL mask, so packing is
-    gated to sp=1 by the trainer).
+    ring-attention caller where each sp shard holds a sequence slice. Packed
+    batches under sp>1 must route through Ulysses (which all-gathers q/k/v
+    AND the mask to full length, restoring the sq == skv pairing); the ring
+    path drops the mask entirely, so the trainer rejects packing + ring.
     """
     b, sq, h, hd = q.shape
     n_rep = h // k.shape[2]

@@ -805,7 +805,10 @@ def make_pipeline_loss_and_grad(
             raise ValueError(
                 f"sequence_parallel=ulysses needs heads/tp divisible by sp: "
                 f"{cfg.num_attention_heads}/{tp} = {local_heads} vs sp={sp} "
-                f"(use sequence_parallel=ring, which has no head constraint)")
+                f"(use sequence_parallel=ring, which has no head constraint — "
+                f"unless the run also packs sequences, which ring does not "
+                f"support: then lower sp to a divisor of the head count, or "
+                f"drop packing_factor)")
     if pcfg.loss_chunks > 1:
         if tp > 1:
             raise ValueError(

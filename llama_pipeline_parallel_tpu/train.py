@@ -272,13 +272,14 @@ def run_training(cfg: dict) -> dict:
 
     packing = _packing_factor(cfg)
     if packing > 1:
-        if mesh_cfg.sp > 1:
+        if mesh_cfg.sp > 1 and cfg.get("sequence_parallel", "ring") != "ulysses":
             raise ValueError(
-                "packing_factor requires sp=1: the ring path drops the "
-                "padding mask entirely (parallel/sp.py passes None — segment "
-                "ids would be silently discarded, letting packed examples "
-                "attend across boundaries), and the Ulysses path, though it "
-                "all-gathers the mask, is unvalidated with segment ids")
+                "packing_factor with sp>1 requires sequence_parallel=ulysses: "
+                "the ring path drops the padding mask entirely (parallel/sp.py "
+                "passes None — segment ids would be silently discarded, "
+                "letting packed examples attend across boundaries); Ulysses "
+                "all-gathers the mask to full length, so segment pairing "
+                "stays positionally exact")
         if cfg.get("attention", "auto") == "flash":
             raise ValueError(
                 "packing_factor requires exact attention: the flash kernel "
@@ -558,7 +559,10 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                 trace_active = False
                 logger.info("profiler trace written to %s/profile", output_dir)
             losses.append(loss)
-            meter.update(batch["input_ids"].size)
+            mask = batch.get("attention_mask")
+            meter.update(batch["input_ids"].size,
+                         real_tokens=None if mask is None
+                         else int((mask != 0).sum()))
             if (step + 1) % logging_steps == 0 or step + 1 == end_step:
                 final_loss = float(losses[-1])
                 writer.log(step + 1, {"loss": float(np.mean([float(l) for l in losses])),

@@ -66,21 +66,30 @@ class Throughput:
     def __post_init__(self) -> None:
         self._t0 = time.perf_counter()
         self._tokens = 0
+        self._real_tokens = 0
         if self.peak_flops_per_chip is None:
             self.peak_flops_per_chip = detect_chip_peak_flops()
 
-    def update(self, tokens: int) -> None:
+    def update(self, tokens: int, real_tokens: int | None = None) -> None:
+        """`tokens` = batch positions (pad included — the compute actually
+        spent, and what MFU is against). `real_tokens` = non-pad positions:
+        the useful-throughput number, where sequence packing's win shows
+        (a padded-to-512 baseline inflates tokens_per_sec with pad work)."""
         self._tokens += tokens
+        self._real_tokens += tokens if real_tokens is None else real_tokens
 
     def read_and_reset(self) -> dict[str, float]:
         dt = max(time.perf_counter() - self._t0, 1e-9)
         tps = self._tokens / dt
         out = {"tokens_per_sec": tps, "tokens_per_sec_per_chip": tps / self.n_chips}
+        if self._real_tokens != self._tokens:
+            out["real_tokens_per_sec"] = self._real_tokens / dt
         if self.peak_flops_per_chip:
             flops = train_flops_per_token(self.cfg, self.seq_length) * tps
             out["mfu"] = flops / (self.peak_flops_per_chip * self.n_chips)
         self._t0 = time.perf_counter()
         self._tokens = 0
+        self._real_tokens = 0
         return out
 
 

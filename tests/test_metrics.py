@@ -40,6 +40,19 @@ def test_throughput_meter_counts_mfu():
     np.testing.assert_allclose(out["mfu"], expected_mfu, rtol=1e-6)
 
 
+def test_throughput_meter_real_tokens():
+    """real_tokens_per_sec reports only when pad positions exist, and in
+    the right proportion to the padded count."""
+    cfg = LlamaConfig.tiny()
+    meter = Throughput(cfg, seq_length=32, n_chips=1, peak_flops_per_chip=1e12)
+    meter.update(1000, real_tokens=250)
+    out = meter.read_and_reset()
+    np.testing.assert_allclose(out["real_tokens_per_sec"],
+                               out["tokens_per_sec"] / 4, rtol=1e-6)
+    meter.update(1000, real_tokens=1000)
+    assert "real_tokens_per_sec" not in meter.read_and_reset()
+
+
 def test_param_count_matches_init():
     import jax
 

@@ -133,8 +133,8 @@ def test_packing_gating(devices, tmp_path):
             "model": {"preset": "tiny", "dtype": "float32"},
             "packing_factor": 2, "max_seq_length": 32, "max_steps": 1,
             "warmup_steps": 1}
-    with pytest.raises(ValueError, match="requires sp=1"):
-        run_training(base)
+    with pytest.raises(ValueError, match="requires sequence_parallel=ulysses"):
+        run_training(base)  # default sequence_parallel=ring drops the mask
     base2 = {**base, "mesh": {}, "attention": "flash"}
     with pytest.raises(ValueError, match="requires exact attention"):
         run_training(base2)
@@ -160,18 +160,14 @@ def tokenizer_dir(tmp_path_factory):
     return str(d)
 
 
-def test_packed_training_end_to_end(devices, tmp_path, tokenizer_dir):
-    """run_training with packing_factor=2 over a real jsonl dataset and
-    tokenizer: packed rows flow through the PP=2 pipeline, loss is finite."""
-    from llama_pipeline_parallel_tpu.train import run_training
-
+def _packed_cfg(tmp_path, tokenizer_dir, out: str, **kw) -> dict:
     rows = [{"inputs": f"what is item {i}", "targets": f"item {i} is thing {i}"}
             for i in range(64)]
     data = tmp_path / "train.jsonl"
-    data.write_text("\n".join(json.dumps(r) for r in rows))
-
+    if not data.exists():
+        data.write_text("\n".join(json.dumps(r) for r in rows))
     cfg = {
-        "output_dir": str(tmp_path / "out"),
+        "output_dir": str(tmp_path / out),
         "mesh": {"pp": 2, "dp": 2},
         "model": {"preset": "tiny", "dtype": "float32",
                   "vocab_size": 128},
@@ -189,6 +185,30 @@ def test_packed_training_end_to_end(devices, tmp_path, tokenizer_dir):
         "logging_steps": 1,
         "save_final": False,
     }
-    summary = run_training(cfg)
+    cfg.update(kw)
+    return cfg
+
+
+def test_packed_training_end_to_end(devices, tmp_path, tokenizer_dir):
+    """run_training with packing_factor=2 over a real jsonl dataset and
+    tokenizer: packed rows flow through the PP=2 pipeline, loss is finite."""
+    from llama_pipeline_parallel_tpu.train import run_training
+
+    summary = run_training(_packed_cfg(tmp_path, tokenizer_dir, "out"))
     assert summary["final_step"] == 2
     assert np.isfinite(summary["final_loss"])
+
+
+def test_packed_ulysses_sp_matches_sp1(devices, tmp_path, tokenizer_dir):
+    """Packing composes with Ulysses sequence parallelism (the mask is
+    all-gathered to full length, so segment pairing stays exact): the sp=2
+    loss equals the sp=1 loss on the identical run."""
+    from llama_pipeline_parallel_tpu.train import run_training
+
+    base = run_training(_packed_cfg(tmp_path, tokenizer_dir, "sp1",
+                                    mesh={"pp": 2, "dp": 1}))
+    sp2 = run_training(_packed_cfg(tmp_path, tokenizer_dir, "sp2",
+                                   mesh={"pp": 2, "dp": 1, "sp": 2},
+                                   sequence_parallel="ulysses"))
+    np.testing.assert_allclose(sp2["final_loss"], base["final_loss"],
+                               rtol=2e-5)
