@@ -18,9 +18,13 @@ implements batched generation the TPU way:
   (a single [b, max_len] 0/1 array), and every row writes the same cache slot
   each step — no per-row dynamic slicing.
 
-Generation here targets single-host meshes (dp/tp via the caller's jit
-sharding if desired); pipelined decode across pp stages is a training-economy
-trade the reference never had either and is out of scope.
+Models too big for one chip shard WITHOUT code changes: Megatron-shard the
+params over a tp mesh (column-parallel qkv/gate/up, row-parallel wo/down,
+vocab-parallel lm_head) and call the same jitted `generate` — GSPMD inserts
+the collectives, and tokens match the unsharded run exactly
+(tests/test_decode.py::test_generate_with_tp_sharded_params). Pipelined
+decode across pp stages is a training-economy trade the reference never had
+either and is out of scope.
 """
 
 from __future__ import annotations
