@@ -71,8 +71,21 @@ def _causal_tile_mask(s, qi, ki, block_q, block_k, q_offset, kv_offset):
 # Forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
-                acc_scr, *, scale, causal, block_q, block_k):
+def _seg_tile_mask(s, segq_ref, segk_ref):
+    """Mask cross-segment pairs (sequence packing): scores survive only
+    where the q and kv positions carry the SAME nonzero segment id."""
+    seg_q = segq_ref[0, :, :]                # [bq, 1] int32
+    seg_k = segk_ref[0, :, :][:, 0][None, :]  # [1, bk]
+    ok = (seg_q == seg_k) & (seg_k != 0)
+    return jnp.where(ok, s, NEG_INF)
+
+
+def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, *rest, scale, causal, block_q,
+                block_k, has_seg):
+    if has_seg:
+        segq_ref, segk_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     n_k = pl.num_programs(3)
@@ -99,11 +112,17 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                                 preferred_element_type=jnp.float32)  # [bq, bk]
         if causal:
             s = _causal_tile_mask(s, qi, ki, block_q, block_k, q_offset, kv_offset)
+        if has_seg:
+            s = _seg_tile_mask(s, segq_ref, segk_ref)
 
         m_prev = m_scr[:, :1]                                   # [bq, 1]
         m_cur = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         correction = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(s - m_cur)                                  # [bq, bk]
+        # masked entries contribute ZERO even when the whole row is masked
+        # (m_cur == NEG_INF would make exp(s - m_cur) = 1 phantom mass; rows
+        # that never see real mass — seg-masked pad rows — must finalize to
+        # the documented 0/NEG_INF empty-row contract)
+        p = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_cur), 0.0)  # [bq, bk]
         l_scr[:] = jnp.broadcast_to(
             correction * l_scr[:, :1] + p.sum(axis=-1, keepdims=True), l_scr.shape)
         acc_scr[:] = acc_scr[:] * correction + jax.lax.dot_general(
@@ -121,9 +140,11 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             l > 0.0, m_scr[:, :1] + jnp.log(safe_l), NEG_INF)
 
 
-def _fwd(q, k, v, *, causal, scale, block_q, block_k, q_offset, kv_offset):
+def _fwd(q, k, v, *, causal, scale, block_q, block_k, q_offset, kv_offset,
+         segments=None):
     """q: [b, h, sq, hd]; k/v: [b, h_kv, skv, hd] -> out [b, h, sq, hd],
-    lse [b, h, sq, 1]."""
+    lse [b, h, sq, 1]. `segments`: [b, s, 1] int32 segment ids (0 = pad),
+    valid only for self-attention (sq == skv, shared array)."""
     b, h, sq, hd = q.shape
     h_kv, skv = k.shape[1], k.shape[2]
     group = h // h_kv
@@ -131,19 +152,29 @@ def _fwd(q, k, v, *, causal, scale, block_q, block_k, q_offset, kv_offset):
     n_q, n_k = sq // bq, skv // bk
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk)
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        has_seg=segments is not None)
     offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                          jnp.asarray(kv_offset, jnp.int32)])
+
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, bq, hd), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        pl.BlockSpec((1, 1, bk, hd), lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
+        pl.BlockSpec((1, 1, bk, hd), lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
+    ]
+    args = [offsets, q, k, v]
+    if segments is not None:
+        in_specs += [
+            pl.BlockSpec((1, bq, 1), lambda b_, h_, qi, ki: (b_, qi, 0)),
+            pl.BlockSpec((1, bk, 1), lambda b_, h_, qi, ki: (b_, ki, 0)),
+        ]
+        args += [segments, segments]
 
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, bq, hd), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, bk, hd), lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
-            pl.BlockSpec((1, 1, bk, hd), lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, hd), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
@@ -158,7 +189,7 @@ def _fwd(q, k, v, *, causal, scale, block_q, block_k, q_offset, kv_offset):
             pltpu.VMEM((bq, hd), jnp.float32),
         ],
         interpret=_interpret_mode(),
-    )(offsets, q, k, v)
+    )(*args)
     return out, lse
 
 
@@ -167,7 +198,11 @@ def _fwd(q, k, v, *, causal, scale, block_q, block_k, q_offset, kv_offset):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scr, *, scale, causal, block_q, block_k):
+                   *rest, scale, causal, block_q, block_k, has_seg):
+    if has_seg:
+        segq_ref, segk_ref, dq_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     n_k = pl.num_programs(3)
@@ -193,6 +228,8 @@ def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = _causal_tile_mask(s, qi, ki, block_q, block_k, q_offset, kv_offset)
+        if has_seg:
+            s = _seg_tile_mask(s, segq_ref, segk_ref)
         p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)  # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -206,8 +243,11 @@ def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, block_q, block_k):
+                    *rest, scale, causal, block_q, block_k, has_seg):
+    if has_seg:
+        segq_ref, segk_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
     ki = pl.program_id(2)
     qi = pl.program_id(3)
     n_q = pl.num_programs(3)
@@ -234,6 +274,8 @@ def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = _causal_tile_mask(s, qi, ki, block_q, block_k, q_offset, kv_offset)
+        if has_seg:
+            s = _seg_tile_mask(s, segq_ref, segk_ref)
         p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)
         dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -251,7 +293,7 @@ def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(q, k_full, v_full, delta, lse, do, *, causal, scale, block_q, block_k,
-         q_offset, kv_offset):
+         q_offset, kv_offset, segments=None):
     """All arrays [b, h, s, hd] (kv pre-expanded to full heads);
     delta = rowsum(dO * O) [b, h, sq, 1] is computed by the caller (the ring
     backward passes the GLOBAL delta for its slab-wise recompute)."""
@@ -260,7 +302,8 @@ def _bwd(q, k_full, v_full, delta, lse, do, *, causal, scale, block_q, block_k,
     bq, bk = _block_sizes(sq, skv, block_q, block_k)
     n_q, n_k = sq // bq, skv // bk
 
-    common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk)
+    common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
+                  has_seg=segments is not None)
     offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                          jnp.asarray(kv_offset, jnp.int32)])
     smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
@@ -268,32 +311,44 @@ def _bwd(q, k_full, v_full, delta, lse, do, *, causal, scale, block_q, block_k,
     k_spec = pl.BlockSpec((1, 1, bk, hd), lambda b_, h_, qi, ki: (b_, h_, ki, 0))
     row_spec = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, qi, ki: (b_, h_, qi, 0))
 
+    in_specs = [smem_spec, q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
+    args = [offsets, q, k_full, v_full, do, lse, delta]
+    if segments is not None:
+        in_specs += [pl.BlockSpec((1, bq, 1), lambda b_, h_, qi, ki: (b_, qi, 0)),
+                     pl.BlockSpec((1, bk, 1), lambda b_, h_, qi, ki: (b_, ki, 0))]
+        args += [segments, segments]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
         grid=(b, h, n_q, n_k),
-        in_specs=[smem_spec, q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, hd), jnp.float32)],
         interpret=_interpret_mode(),
-    )(offsets, q, k_full, v_full, do, lse, delta)
+    )(*args)
 
     # dk/dv: kv tiles outer, q tiles inner.
     q_spec_t = pl.BlockSpec((1, 1, bq, hd), lambda b_, h_, ki, qi: (b_, h_, qi, 0))
     k_spec_t = pl.BlockSpec((1, 1, bk, hd), lambda b_, h_, ki, qi: (b_, h_, ki, 0))
     row_spec_t = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, ki, qi: (b_, h_, qi, 0))
+    in_specs_t = [smem_spec, q_spec_t, k_spec_t, k_spec_t, q_spec_t, row_spec_t,
+                  row_spec_t]
+    args_t = [offsets, q, k_full, v_full, do, lse, delta]
+    if segments is not None:
+        in_specs_t += [pl.BlockSpec((1, bq, 1), lambda b_, h_, ki, qi: (b_, qi, 0)),
+                       pl.BlockSpec((1, bk, 1), lambda b_, h_, ki, qi: (b_, ki, 0))]
+        args_t += [segments, segments]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
         grid=(b, h, n_k, n_q),
-        in_specs=[smem_spec, q_spec_t, k_spec_t, k_spec_t, q_spec_t, row_spec_t,
-                  row_spec_t],
+        in_specs=in_specs_t,
         out_specs=[k_spec_t, k_spec_t],
         out_shape=[jax.ShapeDtypeStruct(k_full.shape, k_full.dtype),
                    jax.ShapeDtypeStruct(v_full.shape, v_full.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, hd), jnp.float32),
                         pltpu.VMEM((bk, hd), jnp.float32)],
         interpret=_interpret_mode(),
-    )(offsets, q, k_full, v_full, do, lse, delta)
+    )(*args_t)
     return dq, dk, dv
 
 
@@ -301,21 +356,25 @@ def _bwd(q, k_full, v_full, delta, lse, do, *, causal, scale, block_q, block_k,
 # Public op with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, scale, block_q, block_k, q_offset, kv_offset):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, segments, causal, scale, block_q, block_k, q_offset,
+           kv_offset):
     out, _ = _fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
-                  block_k=block_k, q_offset=q_offset, kv_offset=kv_offset)
+                  block_k=block_k, q_offset=q_offset, kv_offset=kv_offset,
+                  segments=segments)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, q_offset, kv_offset):
+def _flash_fwd(q, k, v, segments, causal, scale, block_q, block_k, q_offset,
+               kv_offset):
     out, lse = _fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
-                    block_k=block_k, q_offset=q_offset, kv_offset=kv_offset)
-    return out, (q, k, v, out, lse)
+                    block_k=block_k, q_offset=q_offset, kv_offset=kv_offset,
+                    segments=segments)
+    return out, (q, k, v, segments, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, q_offset, kv_offset, res, do):
-    q, k, v, out, lse = res
+    q, k, v, segments, out, lse = res
     h, h_kv = q.shape[1], k.shape[1]
     group = h // h_kv
     # Backward materializes grouped KV at full heads (forward never does);
@@ -327,14 +386,15 @@ def _flash_bwd(causal, scale, block_q, block_k, q_offset, kv_offset, res, do):
                     axis=-1, keepdims=True)  # [b, h, sq, 1]
     dq, dk_full, dv_full = _bwd(
         q, k_full, v_full, delta, lse, do, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, q_offset=q_offset, kv_offset=kv_offset)
+        block_q=block_q, block_k=block_k, q_offset=q_offset,
+        kv_offset=kv_offset, segments=segments)
     if group > 1:
         b, _, skv, hd = dk_full.shape
         dk = dk_full.reshape(b, h_kv, group, skv, hd).sum(axis=2).astype(k.dtype)
         dv = dv_full.reshape(b, h_kv, group, skv, hd).sum(axis=2).astype(v.dtype)
     else:
         dk, dv = dk_full, dv_full
-    return dq, dk, dv
+    return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -354,17 +414,24 @@ def flash_attention(
 ) -> jnp.ndarray:
     """Drop-in AttnFn (same [b, s, h, hd] signature as ops.attention.attention).
 
-    padding_mask is accepted for interface parity but ignored: with causal
-    attention and right-padded batches it is mathematically redundant (see
-    module docstring). Pass left-padded or non-causal workloads to the
-    reference path instead.
+    padding_mask semantics match the exact op (ops/attention.py): it carries
+    SEGMENT IDS (0 = pad, packed examples numbered 1..k). In self-attention
+    (sq == skv) a provided mask turns on the in-kernel cross-segment test —
+    sequence packing works on the flash path. With right-padded causal 0/1
+    masks the test is a no-op, so passing or omitting the mask is equivalent
+    there (the ring caller omits it; its rotated slabs break the positional
+    pairing, see parallel/sp.py).
     """
     if q.shape[2] % k.shape[2]:
         raise ValueError(f"q heads {q.shape[2]} not a multiple of kv heads {k.shape[2]}")
     scale = q.shape[-1] ** -0.5
+    segments = None
+    if padding_mask is not None and q.shape[1] == k.shape[1]:
+        segments = jnp.asarray(padding_mask, jnp.int32)[:, :, None]  # [b, s, 1]
     # kernels run on [b, h, s, hd]
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash(qt, kt, vt, causal, scale, block_q, block_k, q_offset, kv_offset)
+    out = _flash(qt, kt, vt, segments, causal, scale, block_q, block_k,
+                 q_offset, kv_offset)
     return out.transpose(0, 2, 1, 3)

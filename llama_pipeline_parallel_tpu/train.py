@@ -131,6 +131,14 @@ def build_dataset_and_collator(cfg: dict, model_cfg: LlamaConfig) -> tuple[Any, 
     return ds, collator
 
 
+def _flash_without_mask(q, k, v, padding_mask=None, *, causal=True):
+    """flash_attention minus the segment-mask input streams (see
+    select_attention.finish)."""
+    from llama_pipeline_parallel_tpu.ops.flash_attention import flash_attention
+
+    return flash_attention(q, k, v, None, causal=causal)
+
+
 _AUTO_ATTN_CACHE: dict = {}
 
 
@@ -197,7 +205,8 @@ def _measure_attention(model_cfg: LlamaConfig, seq_len: int) -> Any:
 
 def select_attention(impl: str, seq_length: int, mesh,
                      sequence_parallel: str = "ring",
-                     model_cfg: LlamaConfig | None = None) -> Any:
+                     model_cfg: LlamaConfig | None = None,
+                     packed: bool = False) -> Any:
     """'exact' | 'flash' | 'auto'. The reference tried and failed to enable
     flash attention (README.md:141-143); here `auto` MEASURES both paths on
     the device at the run's shape and keeps the faster.
@@ -212,10 +221,21 @@ def select_attention(impl: str, seq_length: int, mesh,
     from llama_pipeline_parallel_tpu.ops.attention import attention
     from llama_pipeline_parallel_tpu.ops.flash_attention import flash_attention
 
+    def finish(fn):
+        """Unpacked single-chip-sequence flash runs skip the kernel's segment
+        streams: a 0/1 mask is a documented no-op there, and dropping it
+        keeps the non-packed hot path identical to the pre-segments kernel.
+        Not applied under sp>1 — make_sp_attention dispatches its ring
+        backend by `inner_attn is flash_attention` identity, and ring drops
+        the mask itself anyway."""
+        if fn is flash_attention and not packed and mesh.shape["sp"] == 1:
+            return _flash_without_mask
+        return fn
+
     if impl == "exact":
         return attention
     if impl == "flash":
-        return flash_attention
+        return finish(flash_attention)
     if impl == "auto":
         sp = mesh.shape["sp"]
         kernel_len = seq_length // sp if (sp > 1 and sequence_parallel == "ring") \
@@ -231,8 +251,8 @@ def select_attention(impl: str, seq_length: int, mesh,
                 "a 1024 multiple to enable flash)", kernel_len, seq_length)
             return attention
         if model_cfg is None:
-            return flash_attention if kernel_len >= 2048 else attention
-        return _measure_attention(model_cfg, kernel_len)
+            return finish(flash_attention) if kernel_len >= 2048 else attention
+        return finish(_measure_attention(model_cfg, kernel_len))
     raise ValueError(f"unknown attention impl {impl!r} (use exact|flash|auto)")
 
 
@@ -280,15 +300,9 @@ def run_training(cfg: dict) -> dict:
                 "letting packed examples attend across boundaries); Ulysses "
                 "all-gathers the mask to full length, so segment pairing "
                 "stays positionally exact")
-        if cfg.get("attention", "auto") == "flash":
-            raise ValueError(
-                "packing_factor requires exact attention: the flash kernel "
-                "has no segment mask — packed examples would attend across "
-                "their boundaries")
-        if cfg.get("attention", "auto") != "exact":
-            logger.info("packing_factor=%d forces attention=exact "
-                        "(segment masking lives in the exact op)", packing)
-            cfg = {**cfg, "attention": "exact"}
+        # both attention backends handle segment masks (the exact op's
+        # pairwise test, the flash kernel's in-tile _seg_tile_mask), so
+        # exact/flash/auto all stay valid under packing
     dataset, collator = build_dataset_and_collator(cfg, model_cfg)
     micro_batch = cfg.get("per_device_train_batch_size", 1)
     # with packing, the loader feeds pack_factor x examples per emitted row
@@ -368,7 +382,8 @@ def run_training(cfg: dict) -> dict:
                          f"sp={mesh_cfg.sp} equal slabs")
     attn_fn = select_attention(cfg.get("attention", "auto"), seq_length, mesh,
                                sequence_parallel=cfg.get("sequence_parallel", "ring"),
-                               model_cfg=model_cfg)
+                               model_cfg=model_cfg,
+                               packed=_packing_factor(cfg) > 1)
     step_fn = ts.make_train_step(mesh, model_cfg, pcfg, tx, schedule,
                                  stacked_template, attn_fn=attn_fn)
 
@@ -673,7 +688,8 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
                          f"sp={mesh.shape['sp']} equal slabs")
     attn_fn = select_attention(cfg.get("attention", "auto"), seq_length, mesh,
                                sequence_parallel=cfg.get("sequence_parallel", "ring"),
-                               model_cfg=model_cfg)
+                               model_cfg=model_cfg,
+                               packed=_packing_factor(cfg) > 1)
     grad_fn = jax.jit(pl.make_pipeline_loss_and_grad(
         mesh, model_cfg, pcfg, stacked_template, attn_fn=attn_fn))
 
