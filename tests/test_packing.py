@@ -118,6 +118,42 @@ def test_packed_collator_drops_overflow():
     assert coll.dropped_total == 3
 
 
+def test_packed_collator_fuzz_invariants():
+    """Property fuzz over random batches: every emitted row satisfies the
+    packing invariants regardless of example lengths/truncation/drops."""
+    tok = FakeTokenizer()
+    r = np.random.RandomState(7)
+    words = [f"w{i}" for i in range(30)]
+    for trial in range(20):
+        L = int(r.choice([8, 16, 24]))
+        factor = int(r.choice([2, 4]))
+        coll = PackedCausalLMCollator(tok, max_seq_length=L, pack_factor=factor)
+        n_ex = factor * int(r.randint(1, 4))
+        examples = [{"inputs": " ".join(r.choice(words, r.randint(1, 9))),
+                     "targets": " ".join(r.choice(words, r.randint(1, 9)))}
+                    for _ in range(n_ex)]
+        batch = coll(examples)
+        rows = n_ex // factor
+        assert batch["input_ids"].shape == (rows, L)
+        for row in range(rows):
+            seg = batch["attention_mask"][row]
+            pos = batch["position_ids"][row]
+            lab = batch["labels"][row]
+            pad = seg == 0
+            # pads carry no ids, no labels, and sit after all segments
+            assert (lab[pad] == IGNORE_INDEX).all()
+            k = seg.max()
+            for s in range(1, k + 1):
+                span = np.flatnonzero(seg == s)
+                # segments are contiguous runs with positions 0..len-1
+                assert (np.diff(span) == 1).all()
+                np.testing.assert_array_equal(pos[span], np.arange(len(span)))
+                assert lab[span[0]] == IGNORE_INDEX  # first token never trains
+            # trained labels always equal their input id
+            t = lab != IGNORE_INDEX
+            np.testing.assert_array_equal(lab[t], batch["input_ids"][row][t])
+
+
 def test_packing_gating(devices, tmp_path):
     from llama_pipeline_parallel_tpu.train import (
         build_dataset_and_collator,
