@@ -101,6 +101,19 @@ def test_offload_loop_runs_and_resumes(tmp_path, devices):
     np.testing.assert_allclose(resumed["final_loss"], straight["final_loss"], rtol=1e-5)
 
 
+def test_offload_save_total_limit(tmp_path, devices):
+    """The retention knob covers the offload save path too: only the newest
+    checkpoint survives at save_total_limit=1."""
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
+
+    cfg = base_cfg(tmp_path, optimizer_offload=True, save_steps=2,
+                   save_total_limit=1, max_steps=4, total_steps=4)
+    out = run_training(cfg)["output_dir"]
+    mgr = CheckpointManager(out)
+    assert mgr.list_steps(complete_only=True) == [4]
+    assert mgr.latest_step() == 4
+
+
 def test_offload_with_uneven_stages(tmp_path, devices):
     """Host-offloaded optimizer composed with an auto-balanced uneven
     partition (5 layers on pp=2): the padded stacked layout must survive the
