@@ -80,6 +80,15 @@ class CheckpointManager:
         self._ckptr = ocp.StandardCheckpointer()
         self._pending: Any = None  # in-flight async commit thread
         self._pending_error: Any = None  # exception raised on that thread
+        self._commit_seq = 0  # collective save counter -> unique barrier keys
+        # Durability backstop (a caller that lets the process exit after
+        # save(blocking=False) must not silently lose meta/tag): finalize on
+        # interpreter exit. Weakref so the hook never pins the manager alive.
+        import atexit
+        import weakref
+
+        ref = weakref.ref(self)
+        atexit.register(lambda: (m := ref()) is not None and m.finalize())
 
     def finalize(self) -> None:
         """Block until a `save(..., blocking=False)` commit (array flush,
@@ -187,24 +196,19 @@ class CheckpointManager:
         one async commit is in flight: the next save (or `finalize()`) joins
         the previous one first, re-raising any background failure.
 
-        MULTI-PROCESS runs demote async to blocking: `_commit`'s barrier is
-        a device collective (`sync_global_devices`), and issuing it from the
-        commit thread while the main thread enqueues training collectives
-        gives different processes different collective orders — a pod
-        deadlock. Single-process needs no barrier, so async is safe there.
+        Async stays async at `process_count > 1` (the reference paid a full
+        barrier + s5cmd stall every 50 steps here, trainer_base_ds_mp.py:
+        205-223): `_commit` synchronizes processes with a coordination-
+        service RPC barrier (`host_barrier`), never a device collective, so
+        the commit thread cannot race the main thread's training
+        collectives. The only cross-process assumption is the one the
+        layout already makes — `root` is shared storage (process 0 alone
+        writes meta/tag for everyone).
 
         `on_complete(path)` runs after the commit (in-thread when async) —
         the off-node sync hook's slot, so it never sees a half-written dir.
         """
         self.finalize()
-        if not blocking and jax.process_count() > 1:
-            if not getattr(self, "_warned_demote", False):
-                self._warned_demote = True
-                logger.warning(
-                    "async save demoted to blocking: %d processes (commit "
-                    "barrier would race training collectives)",
-                    jax.process_count())
-            blocking = True
         path = self.step_dir(step)
         self._ckptr.save(os.path.join(path, "params"),
                          pl.unstack_stages(params_stacked, manifest), force=True)
@@ -273,9 +277,19 @@ class CheckpointManager:
         # process write the completeness marker and tag (concurrent writers
         # of the same shared-storage file would race, and a fast process
         # could otherwise mark the checkpoint complete while a peer's Orbax
-        # writes are still in flight).
+        # writes are still in flight). host_barrier, not barrier(): _commit
+        # may run on the async commit thread, where a device collective
+        # would race training collectives — the RPC barrier cannot.
+        # Barrier keys must be globally unique per wait: root-hash (two
+        # managers may commit in one run) + step + a per-manager collective
+        # save counter (resaving a step after a topology change reuses the
+        # step number).
+        import zlib
+
+        self._commit_seq += 1
+        key = (f"{zlib.crc32(self.root.encode()):08x}-{step}-{self._commit_seq}")
         self._ckptr.wait_until_finished()
-        dist.barrier(f"ckpt-arrays-{step}")
+        dist.host_barrier(f"ckpt-arrays-{key}")
         if jax.process_index() == 0:
             meta = {
                 "step": step,
@@ -288,7 +302,7 @@ class CheckpointManager:
                 json.dump(meta, f, indent=2)
             with open(os.path.join(self.root, LATEST_TAG), "w") as f:
                 f.write(f"checkpoint-{step}")
-        dist.barrier(f"ckpt-commit-{step}")
+        dist.host_barrier(f"ckpt-commit-{key}")
         logger.info("saved checkpoint-%d to %s", step, path)
 
     # -- load -------------------------------------------------------------

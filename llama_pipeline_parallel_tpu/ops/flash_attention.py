@@ -141,10 +141,14 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, *rest, scale, causal, block_q,
 
 
 def _fwd(q, k, v, *, causal, scale, block_q, block_k, q_offset, kv_offset,
-         segments=None):
+         segments_q=None, segments_kv=None):
     """q: [b, h, sq, hd]; k/v: [b, h_kv, skv, hd] -> out [b, h, sq, hd],
-    lse [b, h, sq, 1]. `segments`: [b, s, 1] int32 segment ids (0 = pad),
-    valid only for self-attention (sq == skv, shared array)."""
+    lse [b, h, sq, 1]. `segments_q`/`segments_kv`: [b, s, 1] int32 segment
+    ids (0 = pad) for the q rows and kv columns respectively — the SAME
+    array for self-attention, DIFFERENT slabs under ring rotation
+    (parallel/ring_attention.py rotates the kv stream with its kv slab)."""
+    if (segments_q is None) != (segments_kv is None):
+        raise ValueError("segments_q and segments_kv must be given together")
     b, h, sq, hd = q.shape
     h_kv, skv = k.shape[1], k.shape[2]
     group = h // h_kv
@@ -153,7 +157,7 @@ def _fwd(q, k, v, *, causal, scale, block_q, block_k, q_offset, kv_offset,
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        has_seg=segments is not None)
+        has_seg=segments_q is not None)
     offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                          jnp.asarray(kv_offset, jnp.int32)])
 
@@ -164,12 +168,12 @@ def _fwd(q, k, v, *, causal, scale, block_q, block_k, q_offset, kv_offset,
         pl.BlockSpec((1, 1, bk, hd), lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
     ]
     args = [offsets, q, k, v]
-    if segments is not None:
+    if segments_q is not None:
         in_specs += [
             pl.BlockSpec((1, bq, 1), lambda b_, h_, qi, ki: (b_, qi, 0)),
             pl.BlockSpec((1, bk, 1), lambda b_, h_, qi, ki: (b_, ki, 0)),
         ]
-        args += [segments, segments]
+        args += [segments_q, segments_kv]
 
     out, lse = pl.pallas_call(
         kernel,
@@ -293,17 +297,20 @@ def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(q, k_full, v_full, delta, lse, do, *, causal, scale, block_q, block_k,
-         q_offset, kv_offset, segments=None):
+         q_offset, kv_offset, segments_q=None, segments_kv=None):
     """All arrays [b, h, s, hd] (kv pre-expanded to full heads);
     delta = rowsum(dO * O) [b, h, sq, 1] is computed by the caller (the ring
-    backward passes the GLOBAL delta for its slab-wise recompute)."""
+    backward passes the GLOBAL delta for its slab-wise recompute). Segment
+    streams as in `_fwd`."""
+    if (segments_q is None) != (segments_kv is None):
+        raise ValueError("segments_q and segments_kv must be given together")
     b, h, sq, hd = q.shape
     skv = k_full.shape[2]
     bq, bk = _block_sizes(sq, skv, block_q, block_k)
     n_q, n_k = sq // bq, skv // bk
 
     common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
-                  has_seg=segments is not None)
+                  has_seg=segments_q is not None)
     offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                          jnp.asarray(kv_offset, jnp.int32)])
     smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
@@ -313,10 +320,10 @@ def _bwd(q, k_full, v_full, delta, lse, do, *, causal, scale, block_q, block_k,
 
     in_specs = [smem_spec, q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
     args = [offsets, q, k_full, v_full, do, lse, delta]
-    if segments is not None:
+    if segments_q is not None:
         in_specs += [pl.BlockSpec((1, bq, 1), lambda b_, h_, qi, ki: (b_, qi, 0)),
                      pl.BlockSpec((1, bk, 1), lambda b_, h_, qi, ki: (b_, ki, 0))]
-        args += [segments, segments]
+        args += [segments_q, segments_kv]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
         grid=(b, h, n_q, n_k),
@@ -334,10 +341,10 @@ def _bwd(q, k_full, v_full, delta, lse, do, *, causal, scale, block_q, block_k,
     in_specs_t = [smem_spec, q_spec_t, k_spec_t, k_spec_t, q_spec_t, row_spec_t,
                   row_spec_t]
     args_t = [offsets, q, k_full, v_full, do, lse, delta]
-    if segments is not None:
+    if segments_q is not None:
         in_specs_t += [pl.BlockSpec((1, bq, 1), lambda b_, h_, ki, qi: (b_, qi, 0)),
                        pl.BlockSpec((1, bk, 1), lambda b_, h_, ki, qi: (b_, ki, 0))]
-        args_t += [segments, segments]
+        args_t += [segments_q, segments_kv]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
         grid=(b, h, n_k, n_q),
@@ -361,7 +368,7 @@ def _flash(q, k, v, segments, causal, scale, block_q, block_k, q_offset,
            kv_offset):
     out, _ = _fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
                   block_k=block_k, q_offset=q_offset, kv_offset=kv_offset,
-                  segments=segments)
+                  segments_q=segments, segments_kv=segments)
     return out
 
 
@@ -369,7 +376,7 @@ def _flash_fwd(q, k, v, segments, causal, scale, block_q, block_k, q_offset,
                kv_offset):
     out, lse = _fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
                     block_k=block_k, q_offset=q_offset, kv_offset=kv_offset,
-                    segments=segments)
+                    segments_q=segments, segments_kv=segments)
     return out, (q, k, v, segments, out, lse)
 
 
@@ -387,7 +394,7 @@ def _flash_bwd(causal, scale, block_q, block_k, q_offset, kv_offset, res, do):
     dq, dk_full, dv_full = _bwd(
         q, k_full, v_full, delta, lse, do, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, q_offset=q_offset,
-        kv_offset=kv_offset, segments=segments)
+        kv_offset=kv_offset, segments_q=segments, segments_kv=segments)
     if group > 1:
         b, _, skv, hd = dk_full.shape
         dk = dk_full.reshape(b, h_kv, group, skv, hd).sum(axis=2).astype(k.dtype)

@@ -37,6 +37,13 @@ def initialize_distributed() -> None:
     Initialization only happens when a coordinator is configured in the
     environment (TPU-pod launchers set one of the standard variables);
     plain single-host runs skip it entirely.
+
+    TPU-pod launchers let jax auto-detect the process count and id from the
+    cluster metadata. Generic launchers (and the multi-process CPU test
+    harness, tests/test_multiprocess.py) instead set JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID explicitly — jax itself only reads
+    JAX_COORDINATOR_ADDRESS from the environment, so those two are forwarded
+    here.
     """
     global _initialized
     if _initialized:
@@ -44,7 +51,14 @@ def initialize_distributed() -> None:
     _initialized = True
     if not any(os.environ.get(k) for k in _COORDINATOR_ENVS):
         return  # single-host run: nothing to initialize
-    jax.distributed.initialize()
+    num_processes = os.environ.get("JAX_NUM_PROCESSES")
+    process_id = os.environ.get("JAX_PROCESS_ID")
+    if num_processes is not None or process_id is not None:
+        jax.distributed.initialize(num_processes=int(num_processes),
+                                   process_id=int(process_id),
+                                   cluster_detection_method="deactivate")
+    else:
+        jax.distributed.initialize()
     logger.info("jax.distributed initialized: process %d/%d",
                 jax.process_index(), jax.process_count())
 
@@ -58,6 +72,19 @@ def barrier(tag: str = "sync") -> None:
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices(tag)
+
+
+def host_barrier(tag: str, timeout_s: int = 1800) -> None:
+    """Coordination-service barrier: a plain RPC against the jax distributed
+    client, NO device collective — safe from background threads (the async
+    checkpoint commit), where `barrier()`'s `sync_global_devices` would race
+    the main thread's training collectives and deadlock the pod. `tag` must
+    be unique per wait (the service rejects re-used barrier keys)."""
+    if jax.process_count() == 1:
+        return
+    from orbax.checkpoint import multihost as ocp_multihost
+
+    ocp_multihost.get_barrier_sync_fn()(key=tag, timeout_ms=timeout_s * 1000)
 
 
 def form_global_batch(mesh: Mesh, host_batch: Mapping[str, np.ndarray]) -> dict:

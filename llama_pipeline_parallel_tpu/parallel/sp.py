@@ -42,16 +42,20 @@ def make_sp_attention(kind: str, inner_attn: Callable,
         backend = "flash" if inner_attn is flash_attention else "exact"
 
         def ring_fn(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                    padding_mask: Any = None, *, causal: bool = True) -> jnp.ndarray:
+                    padding_mask: Any = None, *, causal: bool = True,
+                    packed: bool = False) -> jnp.ndarray:
             # Slab rotation needs uniform shapes: expand GQA groups up front.
-            # padding_mask is dropped on purpose — right-padded causal batches
-            # need none (pad rows' losses are IGNORE_INDEX-masked), the same
-            # contract as the flash kernel (ops/flash_attention.py).
+            # The mask is forwarded only when it carries PACKING segment ids:
+            # a plain right-padded 0/1 mask is redundant under causal masking
+            # (pad rows' losses are IGNORE_INDEX-masked, the flash kernel's
+            # contract, ops/flash_attention.py), and dropping it skips the
+            # rotating segment stream on the non-packed hot path.
             group = q.shape[2] // k.shape[2]
             if group > 1:
                 k, v = repeat_kv(k, group), repeat_kv(v, group)
-            return ring_attention(q, k, v, None, causal=causal,
-                                  axis_name=axis_name, backend=backend)
+            return ring_attention(q, k, v, padding_mask if packed else None,
+                                  causal=causal, axis_name=axis_name,
+                                  backend=backend)
 
         return ring_fn
 

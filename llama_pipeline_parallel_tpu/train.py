@@ -51,7 +51,11 @@ from llama_pipeline_parallel_tpu.parallel.distributed import (
 from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
 from llama_pipeline_parallel_tpu.utils.config import instantiate
 from llama_pipeline_parallel_tpu.utils.logging import get_logger
-from llama_pipeline_parallel_tpu.utils.metrics import MetricsWriter, Throughput
+from llama_pipeline_parallel_tpu.utils.metrics import (
+    MetricsWriter,
+    NullMetricsWriter,
+    Throughput,
+)
 
 logger = get_logger(__name__)
 
@@ -499,10 +503,17 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
     runs every `eval_steps`.
     """
     output_dir = cfg["output_dir"]
-    writer = MetricsWriter(output_dir, config_snapshot=cfg,
-                           use_wandb=cfg.get("use_wandb", False),
-                           use_tensorboard=cfg.get("use_tensorboard", False))
-    meter = Throughput(model_cfg, seq_length, n_chips=mesh.devices.size)
+    # Scalars are replicated across processes: process 0 writes for the pod
+    # (reference rank-0 gating, trainer_base_ds_mp.py:360-374).
+    writer = (MetricsWriter(output_dir, config_snapshot=cfg,
+                            use_wandb=cfg.get("use_wandb", False),
+                            use_tensorboard=cfg.get("use_tensorboard", False))
+              if jax.process_index() == 0 else NullMetricsWriter())
+    # This host's batches cover only its own dp shards; scale the meter's
+    # counts to the global batch (n_chips is the global chip count).
+    _, local_dp = host_dp_shard(mesh)
+    meter = Throughput(model_cfg, seq_length, n_chips=mesh.devices.size,
+                       global_scale=mesh.shape["dp"] / local_dp)
     logging_steps = cfg.get("logging_steps", 10)
     save_steps = cfg.get("save_steps", 0)
 
@@ -613,20 +624,6 @@ def _should_stop(local_flag: bool) -> bool:
     return bool(np.any(flags))
 
 
-def _offload_restore_is_single_host() -> None:
-    """Offload training is multi-host, but RESTORING into it stays gated:
-    the restore templates now carry mesh shardings end to end
-    (host.abstract_tree + the sharding-preserving canonical reshape), so the
-    machinery is in place — but this environment is single-host, so the
-    multi-process restore path has never executed on a real pod. Lift this
-    guard after one successful pod-validated resume."""
-    if jax.process_count() > 1:
-        raise NotImplementedError(
-            "offloaded-optimizer restore (resume / model_name_or_path warm "
-            "start) is single-host until pod-validated; multi-host offload "
-            "training itself is supported")
-
-
 def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
                  loader, end_step, stacked_template, mgr) -> dict:
     """Host-offloaded-optimizer training setup (reference ZeRO-offload path,
@@ -666,7 +663,11 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
                 f"model_name_or_path at this checkpoint and use a fresh "
                 f"output_dir (module-only warm start; optimizer moments "
                 f"restart).")
-        _offload_restore_is_single_host()
+        # Multi-host restore works end to end: the templates carry mesh
+        # shardings (host.abstract_tree + the sharding-preserving canonical
+        # reshape), Orbax restores each host's shards locally, and _scatter
+        # reads only addressable shards — executed across real processes by
+        # tests/test_multiprocess.py::test_offload_resume_two_process.
         host.load_masters(mgr.load_params(resume, stacked_template, manifest))
         m, v, step_count = mgr.load_offload_moments(resume, stacked_template,
                                                     manifest)
@@ -674,7 +675,6 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
         resume_step = resume
         logger.info("resumed offloaded state from checkpoint-%d", resume_step)
     elif cfg.get("model_name_or_path"):
-        _offload_restore_is_single_host()
         warm = CheckpointManager(cfg["model_name_or_path"])
         warm_step = warm.latest_step()
         if warm_step is None:

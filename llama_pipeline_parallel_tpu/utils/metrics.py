@@ -56,12 +56,21 @@ def detect_chip_peak_flops() -> float | None:
 
 @dataclasses.dataclass
 class Throughput:
-    """Rolling tokens/sec + MFU meter."""
+    """Rolling tokens/sec + MFU meter.
+
+    `global_scale`: multiplier from the counts `update()` sees to the global
+    batch. A pod host only observes its own dp shards' tokens while `n_chips`
+    is the GLOBAL chip count — without the scale, tokens/sec and MFU
+    under-report by the process count. The trainer passes
+    dp_global / dp_local; real-token counts scale by the same factor (exact
+    for the pad-free case, an even-padding approximation otherwise — an
+    allgather per step just to meter would sync the hot loop)."""
 
     cfg: LlamaConfig
     seq_length: int
     n_chips: int
     peak_flops_per_chip: float | None = None
+    global_scale: float = 1.0
 
     def __post_init__(self) -> None:
         self._t0 = time.perf_counter()
@@ -71,19 +80,20 @@ class Throughput:
             self.peak_flops_per_chip = detect_chip_peak_flops()
 
     def update(self, tokens: int, real_tokens: int | None = None) -> None:
-        """`tokens` = batch positions (pad included — the compute actually
-        spent, and what MFU is against). `real_tokens` = non-pad positions:
-        the useful-throughput number, where sequence packing's win shows
-        (a padded-to-512 baseline inflates tokens_per_sec with pad work)."""
+        """`tokens` = THIS host's batch positions (pad included — the compute
+        actually spent, and what MFU is against). `real_tokens` = non-pad
+        positions: the useful-throughput number, where sequence packing's win
+        shows (a padded-to-512 baseline inflates tokens_per_sec with pad
+        work)."""
         self._tokens += tokens
         self._real_tokens += tokens if real_tokens is None else real_tokens
 
     def read_and_reset(self) -> dict[str, float]:
         dt = max(time.perf_counter() - self._t0, 1e-9)
-        tps = self._tokens / dt
+        tps = self._tokens * self.global_scale / dt
         out = {"tokens_per_sec": tps, "tokens_per_sec_per_chip": tps / self.n_chips}
         if self._real_tokens != self._tokens:
-            out["real_tokens_per_sec"] = self._real_tokens / dt
+            out["real_tokens_per_sec"] = self._real_tokens * self.global_scale / dt
         if self.peak_flops_per_chip:
             flops = train_flops_per_token(self.cfg, self.seq_length) * tps
             out["mfu"] = flops / (self.peak_flops_per_chip * self.n_chips)
@@ -91,6 +101,19 @@ class Throughput:
         self._tokens = 0
         self._real_tokens = 0
         return out
+
+
+class NullMetricsWriter:
+    """The sink for non-zero pod processes: the scalars are replicated across
+    processes, so only process 0 writes (concurrent appenders would interleave
+    duplicate lines into the shared metrics.jsonl, and per-process wandb inits
+    would each register a run)."""
+
+    def log(self, step: int, scalars: dict[str, Any]) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
 
 
 class MetricsWriter:

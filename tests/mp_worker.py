@@ -1,0 +1,154 @@
+"""One "host" of the multi-process CPU pod harness.
+
+tests/test_multiprocess.py spawns N of these as REAL OS processes, each with
+its own jax runtime and a few virtual CPU devices, rendezvousing through
+`jax.distributed.initialize` — the closest single-machine analogue of the
+reference's 2-node/16-GPU deployment (reference README.md:11). Every
+`jax.process_count() > 1` branch in the package executes here for real:
+`form_global_batch`'s multi-host assembly, `host_dp_shard`, the preemption
+allgather, the checkpoint commit barriers, the offload optimizer's
+cross-process grad norm, and the attention-choice broadcast.
+
+Invocation: python mp_worker.py '<json spec>'. The spec carries the scenario
+name, rendezvous info, and scenario arguments; the worker writes its result
+as JSON to `<spec[dir]>/result-<process_id>.json` (exit code 0 iff the
+scenario ran clean).
+"""
+
+import json
+import os
+import re
+import sys
+
+
+def _setup(spec: dict):
+    """Pin the CPU platform + device count, then rendezvous. Must run before
+    jax initializes its backend, hence before any scenario import."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={spec['local_devices']}"
+    ).strip()
+    if spec["num_processes"] > 1:
+        os.environ["JAX_COORDINATOR_ADDRESS"] = spec["coordinator"]
+        os.environ["JAX_NUM_PROCESSES"] = str(spec["num_processes"])
+        os.environ["JAX_PROCESS_ID"] = str(spec["process_id"])
+    else:  # the single-process parity reference must not try to rendezvous
+        for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                  "JAX_PROCESS_ID"):
+            os.environ.pop(k, None)
+
+    import jax
+
+    # the image's sitecustomize force-registers the TPU platform; re-pin
+    jax.config.update("jax_platforms", "cpu")
+
+    from llama_pipeline_parallel_tpu.parallel.distributed import (
+        initialize_distributed,
+    )
+
+    initialize_distributed()
+    assert jax.process_count() == spec["num_processes"], (
+        jax.process_count(), spec["num_processes"])
+
+
+# ---------------------------------------------------------------------------
+# Scenarios
+# ---------------------------------------------------------------------------
+
+def scenario_trainer(spec: dict) -> dict:
+    """The full trainer on this virtual pod — whatever the config asks for
+    (fused or offloaded optimizer, saves, resume, eval)."""
+    from llama_pipeline_parallel_tpu.parallel.distributed import host_dp_shard
+    from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
+    from llama_pipeline_parallel_tpu.train import run_training
+
+    summary = run_training(spec["config"])
+    dp_range = host_dp_shard(make_mesh(MeshConfig(**spec["config"]["mesh"])))
+    return {"final_loss": summary["final_loss"],
+            "final_step": summary["final_step"],
+            "dp_range": list(dp_range)}
+
+
+def scenario_trainer_preempt(spec: dict) -> dict:
+    """Preemption e2e: ONLY the last process gets SIGTERM, mid-run. The
+    allgather in `_should_stop` must stop every process at the same step and
+    the save barriers must commit one agreed-on checkpoint."""
+    import signal
+    import threading
+
+    import jax
+
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
+    from llama_pipeline_parallel_tpu.train import run_training
+
+    if jax.process_index() == jax.process_count() - 1:
+        threading.Timer(spec["signal_after_s"],
+                        lambda: os.kill(os.getpid(), signal.SIGTERM)).start()
+    run_training(spec["config"])
+    step = CheckpointManager(spec["config"]["output_dir"]).latest_step()
+    return {"ckpt_step": step}
+
+
+def scenario_ckpt_async(spec: dict) -> dict:
+    """Async save at process_count > 1 stays async (no blocking demotion) and
+    commits durably through the coordination-service barriers."""
+    import jax
+
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
+    from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+    from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+    from llama_pipeline_parallel_tpu.parallel import train_step as ts
+    from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg = LlamaConfig.tiny(dtype="float32")
+    mesh = make_mesh(MeshConfig.from_world(jax.device_count(), pp=2))
+    manifest = StageManifest.for_config(cfg, 2)
+    params = ts.init_params_sharded(jax.random.PRNGKey(0), cfg, mesh, manifest)
+
+    mgr = CheckpointManager(os.path.join(spec["dir"], "ckpt"))
+    mgr.save(7, params, manifest, cfg, blocking=False)
+    # captured BEFORE finalize: a demoted (blocking) save leaves no thread
+    async_alive = mgr._pending is not None
+    mgr.finalize()
+    complete = mgr.is_complete(7) and mgr.latest_step() == 7
+
+    # second async save: unique barrier keys + previous-commit join
+    mgr.save(9, params, manifest, cfg, blocking=False)
+    mgr.finalize()
+    return {"async_alive": async_alive, "complete": complete,
+            "latest": mgr.latest_step()}
+
+
+def scenario_should_stop(spec: dict) -> dict:
+    """The preemption vote in isolation: one local signal => global stop."""
+    import jax
+
+    from llama_pipeline_parallel_tpu.train import _should_stop
+
+    one_host_flag = _should_stop(jax.process_index() == 1)
+    no_flags = _should_stop(False)
+    return {"one_host_flag": bool(one_host_flag), "no_flags": bool(no_flags)}
+
+
+SCENARIOS = {
+    "trainer": scenario_trainer,
+    "trainer_preempt": scenario_trainer_preempt,
+    "ckpt_async": scenario_ckpt_async,
+    "should_stop": scenario_should_stop,
+}
+
+
+def main() -> None:
+    spec = json.loads(sys.argv[1])
+    _setup(spec)
+    result = SCENARIOS[spec["scenario"]](spec)
+    out = os.path.join(spec["dir"], f"result-{spec['process_id']}.json")
+    with open(out + ".tmp", "w") as f:
+        json.dump(result, f)
+    os.replace(out + ".tmp", out)
+
+
+if __name__ == "__main__":
+    main()

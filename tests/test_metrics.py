@@ -53,6 +53,27 @@ def test_throughput_meter_real_tokens():
     assert "real_tokens_per_sec" not in meter.read_and_reset()
 
 
+def test_throughput_global_scale():
+    """A pod host feeding only its dp shards' tokens must still report
+    GLOBAL tokens/sec and MFU: with global_scale=2 (half the dp replicas
+    local), the same local count yields exactly twice the unscaled rates."""
+    cfg = LlamaConfig.tiny()
+
+    def read_with(scale):
+        meter = Throughput(cfg, seq_length=32, n_chips=4,
+                           peak_flops_per_chip=1e12, global_scale=scale)
+        meter._t0 -= 1.0  # pin the window so rates are comparable
+        meter.update(1000, real_tokens=500)
+        return meter.read_and_reset()
+
+    local, scaled = read_with(1.0), read_with(2.0)
+    np.testing.assert_allclose(scaled["tokens_per_sec"],
+                               2 * local["tokens_per_sec"], rtol=1e-2)
+    np.testing.assert_allclose(scaled["real_tokens_per_sec"],
+                               2 * local["real_tokens_per_sec"], rtol=1e-2)
+    np.testing.assert_allclose(scaled["mfu"], 2 * local["mfu"], rtol=1e-2)
+
+
 def test_param_count_matches_init():
     import jax
 

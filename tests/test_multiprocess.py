@@ -1,0 +1,193 @@
+"""Multi-process execution of every `jax.process_count() > 1` branch.
+
+Spawns REAL OS processes (tests/mp_worker.py) that rendezvous through
+`jax.distributed.initialize` on CPU — the virtual-pod harness SURVEY.md §4(b)
+calls for, taken to its multi-host conclusion (the reference ran 16 GPUs over
+2 nodes, reference README.md:11; nothing below ever ran multi-process before
+this file existed). Parity baselines are produced by the SAME worker run as a
+single process, so distributed vs local is the only variable.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "mp_worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_workers(scenario: str, tmpdir: str, num_processes: int,
+                local_devices: int = 2, timeout: int = 420, **spec_extra) -> list[dict]:
+    """Launch `num_processes` workers, wait, and return their result dicts
+    (ordered by process id). Any non-zero exit fails the test with that
+    worker's stderr tail."""
+    workdir = os.path.join(tmpdir, f"{scenario}-{num_processes}p")
+    os.makedirs(workdir, exist_ok=True)
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {**os.environ, "PYTHONPATH": _REPO}
+    procs, logs = [], []
+    for pid in range(num_processes):
+        spec = {"scenario": scenario, "dir": workdir, "coordinator": coordinator,
+                "num_processes": num_processes, "process_id": pid,
+                "local_devices": local_devices, **spec_extra}
+        log = open(os.path.join(workdir, f"worker-{pid}.log"), "w+")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER, json.dumps(spec)],
+            stdout=log, stderr=subprocess.STDOUT, env=env, cwd=_REPO))
+    try:
+        for pid, p in enumerate(procs):
+            rc = p.wait(timeout=timeout)
+            if rc != 0:
+                logs[pid].seek(0)
+                pytest.fail(f"worker {pid}/{num_processes} of {scenario!r} "
+                            f"exited {rc}:\n{logs[pid].read()[-4000:]}")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()
+    results = []
+    for pid in range(num_processes):
+        with open(os.path.join(workdir, f"result-{pid}.json")) as f:
+            results.append(json.load(f))
+    return results
+
+
+def tiny_train_cfg(output_dir: str, **kw) -> dict:
+    cfg = {
+        "output_dir": output_dir,
+        "mesh": {"pp": 2, "dp": 2},
+        "model": {"preset": "tiny", "dtype": "float32"},
+        "dataset": {"synthetic": True, "seq_length": 16, "pseudo_dataset_len": 128},
+        "seed": 11,
+        "per_device_train_batch_size": 2,
+        "gradient_accumulation_steps": 2,
+        "max_steps": 4,
+        "learning_rate": 1e-3,
+        "warmup_steps": 1,
+        "logging_steps": 2,
+        "save_steps": 0,
+        "attention": "exact",
+    }
+    cfg.update(kw)
+    return cfg
+
+
+def test_should_stop_agreement(tmp_path):
+    """One host's preemption signal becomes a unanimous stop; no signal stays
+    a unanimous go (train.py _should_stop allgather)."""
+    results = run_workers("should_stop", str(tmp_path), num_processes=2,
+                          local_devices=1)
+    assert all(r == {"one_host_flag": True, "no_flags": False} for r in results)
+
+
+def test_two_process_train_parity(tmp_path):
+    """A pp=2 x dp=2 run split over 2 processes matches the identical run on
+    one process bit-for-bit-close: form_global_batch's multi-host assembly,
+    host_dp_shard, and the jitted step under a cross-process mesh all line up."""
+    dist = run_workers(
+        "trainer", str(tmp_path), num_processes=2, local_devices=2,
+        config=tiny_train_cfg(os.path.join(str(tmp_path), "dist")))
+    ref = run_workers(
+        "trainer", str(tmp_path), num_processes=1, local_devices=4,
+        config=tiny_train_cfg(os.path.join(str(tmp_path), "ref")))
+    assert dist[0]["final_step"] == 4
+    assert dist[0]["final_loss"] == pytest.approx(dist[1]["final_loss"], rel=1e-6)
+    np.testing.assert_allclose(dist[0]["final_loss"], ref[0]["final_loss"],
+                               rtol=1e-5)
+
+
+def test_dp_sharded_loading_and_metering(tmp_path):
+    """Pure dp=4 over 2 processes: each host loads ONLY its own dp shards
+    (host_dp_shard gives each a disjoint range) yet the loss matches the
+    single-process run over the full batch — and the throughput meter scales
+    host-local token counts back to the global batch (the pod MFU
+    under-report fix)."""
+    out = os.path.join(str(tmp_path), "dist")
+    dist = run_workers(
+        "trainer", str(tmp_path), num_processes=2, local_devices=2,
+        config=tiny_train_cfg(out, mesh={"dp": 4}))
+    ref = run_workers(
+        "trainer", str(tmp_path), num_processes=1, local_devices=4,
+        config=tiny_train_cfg(os.path.join(str(tmp_path), "ref"),
+                              mesh={"dp": 4}))
+    np.testing.assert_allclose(dist[0]["final_loss"], ref[0]["final_loss"],
+                               rtol=1e-5)
+    # each host owns a DISJOINT half of the dp range (this is what feeds the
+    # meter's global_scale = dp/local = 2; the scale arithmetic itself is
+    # pinned by test_metrics.py::test_throughput_global_scale)
+    assert dist[0]["dp_range"] == [0, 2] and dist[1]["dp_range"] == [2, 2]
+    assert ref[0]["dp_range"] == [0, 4]
+    # metrics.jsonl is written by process 0 only: exactly one line per
+    # logging boundary (4 steps / logging_steps=2), no interleaved duplicates
+    dist_lines = [json.loads(l) for l in open(os.path.join(out, "metrics.jsonl"))]
+    assert len(dist_lines) == 2
+    assert all("tokens_per_sec" in l for l in dist_lines)
+
+
+def test_preemption_signal_two_process(tmp_path):
+    """SIGTERM delivered to ONE process mid-run: both processes agree on the
+    stop step via the allgather, write one complete checkpoint together
+    (commit barriers), and exit 0."""
+    out = os.path.join(str(tmp_path), "preempt")
+    cfg = tiny_train_cfg(out, max_steps=100000, total_steps=100000,
+                         preempt_check_every=1, logging_steps=1000,
+                         save_final=True)
+    results = run_workers("trainer_preempt", str(tmp_path), num_processes=2,
+                          local_devices=2, config=cfg, signal_after_s=3.0)
+    step0, step1 = results[0]["ckpt_step"], results[1]["ckpt_step"]
+    assert step0 is not None and step0 == step1
+    assert 0 < step0 < 100000
+    # the checkpoint is complete and resumable: meta.json written once by
+    # process 0 after every process's arrays landed
+    meta = json.load(open(os.path.join(out, f"checkpoint-{step0}", "meta.json")))
+    assert meta["step"] == step0 and meta["has_optimizer_state"]
+
+
+def test_async_checkpoint_stays_async_multiprocess(tmp_path):
+    """At process_count=2 an async save must keep its background commit
+    thread (round 2 demoted it to blocking) and still produce a complete,
+    latest-tagged checkpoint via the RPC barriers."""
+    results = run_workers("ckpt_async", str(tmp_path), num_processes=2,
+                          local_devices=2)
+    for r in results:
+        assert r["async_alive"], "async save was demoted to blocking"
+        assert r["complete"]
+        assert r["latest"] == 9
+
+
+def test_offload_trainer_two_process_resume(tmp_path):
+    """The 65B config-of-record lifecycle at tiny scale across real
+    processes: host-offloaded optimizer (cross-process grad-norm allgather),
+    streamed offload checkpoint, THEN a second 2-process run restores
+    masters+moments through the sharded templates (the round-2
+    NotImplementedError gate, now lifted) and matches the uninterrupted run."""
+    base = dict(tiny_train_cfg("", optimizer_offload=True, learning_rate=1e-2,
+                               max_steps=8, total_steps=8))
+    straight = run_workers(
+        "trainer", str(tmp_path), num_processes=2, local_devices=2,
+        config=dict(base, output_dir=os.path.join(str(tmp_path), "straight")))
+
+    interrupted_dir = os.path.join(str(tmp_path), "interrupted")
+    run_workers("trainer", str(tmp_path), num_processes=2, local_devices=2,
+                config=dict(base, output_dir=interrupted_dir, max_steps=4))
+    resumed = run_workers(
+        "trainer", str(tmp_path), num_processes=2, local_devices=2,
+        config=dict(base, output_dir=interrupted_dir))
+
+    assert resumed[0]["final_step"] == 8
+    np.testing.assert_allclose(resumed[0]["final_loss"],
+                               straight[0]["final_loss"], rtol=1e-5)
