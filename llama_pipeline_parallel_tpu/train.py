@@ -260,8 +260,107 @@ def select_attention(impl: str, seq_length: int, mesh,
     raise ValueError(f"unknown attention impl {impl!r} (use exact|flash|auto)")
 
 
+# Preemption state shared between the signal handlers (installed at trainer
+# entry, BEFORE jax.distributed.initialize) and the step loop. Module-level so
+# a signal landing during the minutes of setup/compile is still seen when the
+# loop finally starts. Mutated ONLY from the main thread (install/release
+# guard) and the signal handler, which also runs in the main thread.
+_STOP_SIGNALS: list[int] = []
+_INSTALLED_SIGNALS: list[int] = []
+_PREVIOUS_HANDLERS: dict = {}
+
+
+def _in_main_thread() -> bool:
+    import threading
+
+    return threading.current_thread() is threading.main_thread()
+
+
+def _on_preemption_signal(sig, frame):
+    _STOP_SIGNALS.append(sig)
+    # async-signal-safe notice — without it a Ctrl+C during minutes of
+    # setup/compile looks ignored (the stop only happens at the next step)
+    os.write(2, b"\n[trainer] signal received; will checkpoint at the next "
+                b"step and exit (signal again to force-quit)\n")
+    # restore defaults so a second Ctrl+C force-quits a wedged save — but
+    # only for the signals WE still own: SIGTERM passes to the C++ notifier
+    # when jax.distributed initializes AFTER the install, and writing its
+    # sigaction then would disable the pod-wide preemption protocol
+    for s in _INSTALLED_SIGNALS:
+        if s == signal.SIGTERM and _cpp_notifier_owns_sigterm():
+            continue
+        signal.signal(s, signal.SIG_DFL)
+
+
+def _cpp_notifier_owns_sigterm() -> bool:
+    """True iff jax's C++ preemption notifier holds the SIGTERM sigaction.
+
+    The notifier is registered with the preemption SYNC MANAGER, not the
+    bare distributed client: `jax.distributed.initialize()` skips it when
+    `jax_enable_preemption_service=False`, and then Python must keep owning
+    SIGTERM even though a client is active."""
+    from jax._src import distributed as jax_distributed
+
+    return jax_distributed.global_state.preemption_sync_manager is not None
+
+
+def _install_preemption_handlers() -> None:
+    """Record SIGTERM/SIGINT — the TPU-VM maintenance-event notice — from the
+    very start of the run. Must run before `jax.distributed.initialize`: on a
+    pod the runtime's C++ preemption notifier takes SIGTERM over from Python
+    (preemption_notifier.cc registers its own sigaction), after which the
+    signal is only observable through the coordination service's sync point
+    (`_preemption_notice`); these Python handlers cover the pre-init window
+    and all single-process runs.
+
+    If a caller initialized jax.distributed BEFORE calling run_training, the
+    notifier already owns SIGTERM and taking it back would silently disable
+    the coordination-service protocol pod-wide — leave it alone and own only
+    SIGINT there.
+
+    A run on a worker thread (embedded caller) installs nothing and must not
+    touch the module state — it may belong to a concurrent main-thread run."""
+    if not _in_main_thread():
+        return
+    signals = [signal.SIGINT] if _cpp_notifier_owns_sigterm() \
+        else [signal.SIGTERM, signal.SIGINT]
+    _STOP_SIGNALS.clear()  # a stale flag from a prior run must not stop this one
+    for sig in signals:
+        prev = signal.signal(sig, _on_preemption_signal)
+        # a None "previous" is a sigaction installed by non-Python code —
+        # signal.signal can't reinstate it; record SIG_DFL so the restore
+        # path never leaves OUR handler dangling after the run
+        _PREVIOUS_HANDLERS[sig] = signal.SIG_DFL if prev is None else prev
+        _INSTALLED_SIGNALS.append(sig)
+
+
+def _release_preemption_handlers() -> None:
+    """Restore the pre-run handlers. Idempotent (second call is a no-op), so
+    _train_loop can hand the signals back before the final save — a Ctrl+C
+    there must interrupt, not be swallowed by handlers nothing re-checks —
+    and run_training's finally stays the backstop for every other exit."""
+    if not _in_main_thread():
+        return
+    for sig, handler in list(_PREVIOUS_HANDLERS.items()):
+        # never restore over the C++ notifier's SIGTERM sigaction — it must
+        # keep feeding the coordination service for later runs in this process
+        if not (sig == signal.SIGTERM and _cpp_notifier_owns_sigterm()):
+            signal.signal(sig, handler)
+        del _PREVIOUS_HANDLERS[sig]
+    _STOP_SIGNALS.clear()
+    _INSTALLED_SIGNALS.clear()
+
+
 def run_training(cfg: dict) -> dict:
     """The full training run; returns a summary dict for programmatic callers."""
+    _install_preemption_handlers()
+    try:
+        return _run_training(cfg)
+    finally:
+        _release_preemption_handlers()
+
+
+def _run_training(cfg: dict) -> dict:
     seed = cfg.get("seed", 42)
     output_dir = cfg["output_dir"]
 
@@ -415,8 +514,9 @@ def run_training(cfg: dict) -> dict:
     do_eval = _make_evaluator(cfg, mesh, model_cfg, pcfg, stacked_template,
                               attn_fn, lambda: state_box[0].params)
     try:
-        final_loss = _train_loop(cfg, model_cfg, mesh, loader, seq_length,
-                                 resume_step, end_step, do_step, do_save, do_eval)
+        final_loss, preempted_at = _train_loop(
+            cfg, model_cfg, mesh, loader, seq_length,
+            resume_step, end_step, do_step, do_save, do_eval)
     except BaseException:
         # join the in-flight commit, but never let ITS failure replace the
         # training exception that actually killed the run
@@ -427,7 +527,17 @@ def run_training(cfg: dict) -> dict:
                              "unwinding a training error")
         raise
     mgr.finalize()  # surface any async-commit failure on the clean path
-    return {"final_step": end_step, "final_loss": final_loss,
+    return _summarize(final_loss, preempted_at, end_step, steps_per_epoch,
+                      output_dir)
+
+
+def _summarize(final_loss, preempted_at, end_step, steps_per_epoch,
+               output_dir) -> dict:
+    """The run summary contract shared by both optimizer paths: final_step is
+    the step the run actually stopped at (a preempted run never reached
+    end_step)."""
+    return {"final_step": end_step if preempted_at is None else preempted_at,
+            "final_loss": final_loss, "preempted_at": preempted_at,
             "steps_per_epoch": steps_per_epoch, "output_dir": output_dir}
 
 
@@ -538,25 +648,15 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
         next(it)
     it = PrefetchIterator(it, depth=cfg.get("prefetch_depth", 2))
 
-    # Preemption-aware save (SURVEY.md §5.3): on SIGTERM/SIGINT — the TPU-VM
-    # maintenance-event notice — finish the current step, checkpoint, exit
-    # cleanly so the next run resumes instead of losing the interval. After
-    # the first signal the default handlers come back, so a second Ctrl+C
-    # force-quits a wedged save.
-    stop_signal: list[int] = []
-
-    def _on_signal(sig, frame):
-        stop_signal.append(sig)
-        for s in (signal.SIGTERM, signal.SIGINT):
-            signal.signal(s, signal.SIG_DFL)
-
-    previous_handlers = {
-        sig: signal.signal(sig, _on_signal)
-        for sig in (signal.SIGTERM, signal.SIGINT)
-    }
-
+    # Preemption-aware save (SURVEY.md §5.3): on a preemption notice —
+    # Python-handler flag (single-process / pre-init window) or the
+    # coordination service's sync point (pod) — finish the current step,
+    # checkpoint, exit cleanly so the next run resumes instead of losing the
+    # interval. Handlers are installed by run_training before distributed
+    # init; see _install_preemption_handlers.
     losses: list = []  # jax scalars; fetched only at logging boundaries
     final_loss = float("nan")
+    preempted_at = None  # the step THIS process observed the stop at
     last_saved = -1
     # Pods agree on preemption via a host collective; running it every step
     # would sync the hot loop, so check on a fixed cadence — the SAME steps on
@@ -566,10 +666,17 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
 
     try:
         for step in range(resume_step, end_step):
+            # The sync point must be polled EVERY step with the loop's step id
+            # (the protocol computes max-step+1 as the one safe stop step for
+            # the whole pod); it returns True on every process at that same
+            # step. The allgather vote covers Python-handler signals on its
+            # own cadence.
+            preempt_notice = _preemption_notice(step)
             check_now = jax.process_count() == 1 or step % check_every == 0
-            if check_now and _should_stop(bool(stop_signal)):
+            if preempt_notice or (check_now and _should_stop(bool(_STOP_SIGNALS))):
                 logger.warning("preemption signal; checkpointing at step %d and "
                                "exiting for clean resume", step)
+                preempted_at = step
                 do_save(step, final=True)
                 last_saved = end_step  # suppress the save_final duplicate
                 break
@@ -604,12 +711,35 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
         if trace_active:  # preemption break / exception inside the window
             jax.profiler.stop_trace()
             logger.info("profiler trace (early exit) written to %s/profile", output_dir)
-        for sig, handler in previous_handlers.items():
-            signal.signal(sig, handler)
         writer.close()
+        # The loop is over on every path out of here: nothing re-checks
+        # _STOP_SIGNALS anymore, so holding the graceful handlers would
+        # silently swallow a Ctrl+C during the final save or during
+        # run_training's async-commit join on the exception path. Hand the
+        # signals back (pre-refactor behavior: an interrupt there raises
+        # KeyboardInterrupt immediately).
+        _release_preemption_handlers()
     if cfg.get("save_final", True) and last_saved != end_step:
         do_save(end_step, final=True)
-    return final_loss
+    return final_loss, preempted_at
+
+
+def _preemption_notice(step: int) -> bool:
+    """Poll the JAX coordination service's preemption sync point.
+
+    Once `jax.distributed.initialize()` registers the preemption sync
+    manager, its C++ notifier owns SIGTERM (preemption_notifier.cc) — the
+    Python handlers never fire, no matter when they were installed. The
+    notifier feeds the service, which propagates the notice to every process
+    and picks one safe stop step (max current step + 1); this returns True
+    on all processes at exactly that step. Without the sync manager
+    (single-process, or service disabled by config) it is a no-op and the
+    Python-handler path applies."""
+    if not _cpp_notifier_owns_sigterm():
+        return False
+    from jax.experimental import multihost_utils
+
+    return bool(multihost_utils.reached_preemption_sync_point(step))
 
 
 def _should_stop(local_flag: bool) -> bool:
@@ -714,7 +844,8 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
 
     do_eval = _make_evaluator(cfg, mesh, model_cfg, pcfg, stacked_template,
                               attn_fn, lambda: device_params_box[0])
-    final_loss = _train_loop(cfg, model_cfg, mesh, loader, seq_length,
-                             resume_step, end_step, do_step, do_save, do_eval)
-    return {"final_step": end_step, "final_loss": final_loss,
-            "steps_per_epoch": len(loader), "output_dir": output_dir}
+    final_loss, preempted_at = _train_loop(
+        cfg, model_cfg, mesh, loader, seq_length,
+        resume_step, end_step, do_step, do_save, do_eval)
+    return _summarize(final_loss, preempted_at, end_step, len(loader),
+                      output_dir)

@@ -72,11 +72,21 @@ def scenario_trainer(spec: dict) -> dict:
 
 
 def scenario_trainer_preempt(spec: dict) -> dict:
-    """Preemption e2e: ONLY the last process gets SIGTERM, mid-run. The
-    allgather in `_should_stop` must stop every process at the same step and
-    the save barriers must commit one agreed-on checkpoint."""
+    """Preemption e2e: ONLY the last process gets SIGTERM, mid-run. Under the
+    jax distributed runtime the C++ notifier consumes the signal and the
+    coordination service's sync point (train._preemption_notice) must stop
+    every process at the same step; the save barriers then commit one
+    agreed-on checkpoint.
+
+    The signal fires only AFTER training observably progressed (first
+    metrics.jsonl line, written by process 0 at logging_steps boundaries)
+    plus a spec-seeded random extra delay — a fixed timer lands in
+    setup/compile on a loaded machine and turns the test into a race
+    (round-3 advisor finding)."""
+    import random
     import signal
     import threading
+    import time
 
     import jax
 
@@ -84,11 +94,32 @@ def scenario_trainer_preempt(spec: dict) -> dict:
     from llama_pipeline_parallel_tpu.train import run_training
 
     if jax.process_index() == jax.process_count() - 1:
-        threading.Timer(spec["signal_after_s"],
-                        lambda: os.kill(os.getpid(), signal.SIGTERM)).start()
-    run_training(spec["config"])
+        metrics = os.path.join(spec["config"]["output_dir"], "metrics.jsonl")
+        rng = random.Random(spec.get("signal_seed", 0))
+        lo, hi = spec.get("signal_delay_range_s", [0.2, 1.5])
+
+        def _signal_after_progress():
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                if os.path.exists(metrics) and os.path.getsize(metrics) > 0:
+                    time.sleep(rng.uniform(lo, hi))
+                    os.kill(os.getpid(), signal.SIGTERM)
+                    return
+                time.sleep(0.1)
+            # a SIGTERM here would only feed the notifier of a process that
+            # is wedged BEFORE the step loop (nothing polls the notice) — hard
+            # -exit instead so the test fails fast with this line in the log
+            print("progress gate expired: no metrics line within 300s; "
+                  "aborting worker", flush=True)
+            os._exit(3)
+
+        threading.Thread(target=_signal_after_progress, daemon=True).start()
+    summary = run_training(spec["config"])
     step = CheckpointManager(spec["config"]["output_dir"]).latest_step()
-    return {"ckpt_step": step}
+    # stop_step is the step THIS process observed its own loop break at —
+    # the cross-process agreement evidence (ckpt_step alone is one shared
+    # filesystem read and would match even if the processes disagreed)
+    return {"ckpt_step": step, "stop_step": summary["preempted_at"]}
 
 
 def scenario_ckpt_async(spec: dict) -> dict:

@@ -46,13 +46,30 @@ def run_workers(scenario: str, tmpdir: str, num_processes: int,
         procs.append(subprocess.Popen(
             [sys.executable, _WORKER, json.dumps(spec)],
             stdout=log, stderr=subprocess.STDOUT, env=env, cwd=_REPO))
+    def _fail(pid: int, why: str):
+        logs[pid].seek(0)
+        pytest.fail(f"worker {pid}/{num_processes} of {scenario!r} {why}:\n"
+                    f"{logs[pid].read()[-4000:]}")
+
     try:
-        for pid, p in enumerate(procs):
-            rc = p.wait(timeout=timeout)
-            if rc != 0:
-                logs[pid].seek(0)
-                pytest.fail(f"worker {pid}/{num_processes} of {scenario!r} "
-                            f"exited {rc}:\n{logs[pid].read()[-4000:]}")
+        # poll round-robin, not in pid order: the first worker to die (any
+        # pid) must surface ITS log, instead of the test blocking on pid 0
+        # until the deadline hides the actual diagnostic
+        import time as _time
+
+        deadline = _time.time() + timeout
+        pending = set(range(num_processes))
+        while pending:
+            for pid in sorted(pending):
+                rc = procs[pid].poll()
+                if rc is None:
+                    continue
+                pending.discard(pid)
+                if rc != 0:
+                    _fail(pid, f"exited {rc}")
+            if pending and _time.time() > deadline:
+                _fail(min(pending), f"still running after {timeout}s")
+            _time.sleep(0.2)
     finally:
         for p in procs:
             if p.poll() is None:
@@ -139,18 +156,23 @@ def test_dp_sharded_loading_and_metering(tmp_path):
 
 
 def test_preemption_signal_two_process(tmp_path):
-    """SIGTERM delivered to ONE process mid-run: both processes agree on the
-    stop step via the allgather, write one complete checkpoint together
-    (commit barriers), and exit 0."""
+    """SIGTERM delivered to ONE process mid-run: the jax runtime's C++
+    notifier consumes it, the coordination service's sync point stops both
+    processes at the same step (train._preemption_notice), they write one
+    complete checkpoint together (commit barriers), and exit 0. The worker
+    only signals after the first metrics line proves training started."""
     out = os.path.join(str(tmp_path), "preempt")
     cfg = tiny_train_cfg(out, max_steps=100000, total_steps=100000,
-                         preempt_check_every=1, logging_steps=1000,
+                         preempt_check_every=1, logging_steps=1,
                          save_final=True)
     results = run_workers("trainer_preempt", str(tmp_path), num_processes=2,
-                          local_devices=2, config=cfg, signal_after_s=3.0)
+                          local_devices=2, config=cfg, signal_seed=7)
     step0, step1 = results[0]["ckpt_step"], results[1]["ckpt_step"]
     assert step0 is not None and step0 == step1
     assert 0 < step0 < 100000
+    # per-process observed stop steps prove the pod agreed on ONE step
+    # (ckpt_step is a shared filesystem read and can't show disagreement)
+    assert results[0]["stop_step"] == results[1]["stop_step"] == step0
     # the checkpoint is complete and resumable: meta.json written once by
     # process 0 after every process's arrays landed
     meta = json.load(open(os.path.join(out, f"checkpoint-{step0}", "meta.json")))
