@@ -48,9 +48,10 @@ def attention(
     Never a materialized [L, L] tensor either way.
     q_offset/kv_offset: global positions of the local q/kv blocks, used by the
     ring-attention caller where each sp shard holds a sequence slice. Packed
-    batches under sp>1 must route through Ulysses (which all-gathers q/k/v
-    AND the mask to full length, restoring the sq == skv pairing); the ring
-    path drops the mask entirely, so the trainer rejects packing + ring.
+    batches under sp>1 work on both strategies: Ulysses all-gathers q/k/v AND
+    the mask to full length (restoring the sq == skv pairing); ring rotates
+    the kv segment slab with its k/v and masks pairwise per slab
+    (parallel/ring_attention.py).
     """
     b, sq, h, hd = q.shape
     n_rep = h // k.shape[2]

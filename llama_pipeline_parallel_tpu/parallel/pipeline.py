@@ -123,6 +123,13 @@ class PipelineConfig:
     # tp>1 already avoids full logits via the vocab-parallel CE; combining
     # the two is rejected at build time.
     loss_chunks: int = 1
+    # Batches carry PACKING segment ids in `attention_mask` (the packed
+    # collator's contract, data/collator.py): under sp the ring strategy then
+    # rotates the kv segment slab with its k/v so packed examples never
+    # attend across pack boundaries; Ulysses all-gathers the mask either way.
+    # At sp=1 both attention backends already read segments from the mask,
+    # so this knob only affects the sp wrappers.
+    packed: bool = False
 
     def __post_init__(self) -> None:
         from llama_pipeline_parallel_tpu.parallel.sp import SP_STRATEGIES
@@ -752,7 +759,8 @@ def make_pipeline_eval_fn(
     param_specs = stage_param_specs(params_like, tp=mesh.shape[AXIS_TP] > 1)
     b_specs = batch_specs(mesh)
     if mesh.shape[AXIS_SP] > 1:
-        attn_fn = make_sp_attention(pcfg.sequence_parallel, attn_fn)
+        attn_fn = make_sp_attention(pcfg.sequence_parallel, attn_fn,
+                                    packed=pcfg.packed)
 
     def local(params, batch):
         labels = batch["labels"]
@@ -805,10 +813,7 @@ def make_pipeline_loss_and_grad(
             raise ValueError(
                 f"sequence_parallel=ulysses needs heads/tp divisible by sp: "
                 f"{cfg.num_attention_heads}/{tp} = {local_heads} vs sp={sp} "
-                f"(use sequence_parallel=ring, which has no head constraint — "
-                f"unless the run also packs sequences, which ring does not "
-                f"support: then lower sp to a divisor of the head count, or "
-                f"drop packing_factor)")
+                f"(use sequence_parallel=ring, which has no head constraint)")
     if pcfg.loss_chunks > 1:
         if tp > 1:
             raise ValueError(
@@ -830,7 +835,8 @@ def make_pipeline_loss_and_grad(
                              f"(vocab-parallel lm_head)")
     param_specs = stage_param_specs(params_like, tp=tp > 1)
     if sp > 1:
-        attn_fn = make_sp_attention(pcfg.sequence_parallel, attn_fn)
+        attn_fn = make_sp_attention(pcfg.sequence_parallel, attn_fn,
+                                    packed=pcfg.packed)
 
     fn = shard_map(
         partial(_loss_and_grad_local, cfg=cfg, pcfg=pcfg, attn_fn=attn_fn),

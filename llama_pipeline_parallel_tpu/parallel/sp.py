@@ -28,13 +28,18 @@ SP_STRATEGIES = ("ring", "ulysses")
 
 
 def make_sp_attention(kind: str, inner_attn: Callable,
-                      axis_name: str = AXIS_SP) -> Callable:
+                      axis_name: str = AXIS_SP,
+                      packed: bool = False) -> Callable:
     """Wrap an AttnFn so it computes full-sequence attention over sp shards.
 
     `inner_attn` is the attention the run would use without sp (exact or the
     Pallas flash kernel): Ulysses calls it directly on the re-sharded
     full-sequence view; ring selects its per-slab backend to match
     (flash kernels when `inner_attn` is the flash path, einsum otherwise).
+
+    `packed`: the run's batches carry PACKING segment ids in the mask
+    (PipelineConfig.packed — a static, whole-run property, so it is bound
+    here rather than threaded through every attention call).
     """
     if kind == "ring":
         from llama_pipeline_parallel_tpu.ops.flash_attention import flash_attention
@@ -42,14 +47,15 @@ def make_sp_attention(kind: str, inner_attn: Callable,
         backend = "flash" if inner_attn is flash_attention else "exact"
 
         def ring_fn(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                    padding_mask: Any = None, *, causal: bool = True,
-                    packed: bool = False) -> jnp.ndarray:
+                    padding_mask: Any = None, *, causal: bool = True) -> jnp.ndarray:
             # Slab rotation needs uniform shapes: expand GQA groups up front.
-            # The mask is forwarded only when it carries PACKING segment ids:
-            # a plain right-padded 0/1 mask is redundant under causal masking
-            # (pad rows' losses are IGNORE_INDEX-masked, the flash kernel's
-            # contract, ops/flash_attention.py), and dropping it skips the
-            # rotating segment stream on the non-packed hot path.
+            # The mask is forwarded only when it carries PACKING segment ids
+            # (the kv segment slab then rotates around the ring with its k/v,
+            # parallel/ring_attention.py): a plain right-padded 0/1 mask is
+            # redundant under causal masking (pad rows' losses are
+            # IGNORE_INDEX-masked, the flash kernel's contract,
+            # ops/flash_attention.py), and dropping it skips the rotating
+            # segment stream on the non-packed hot path.
             group = q.shape[2] // k.shape[2]
             if group > 1:
                 k, v = repeat_kv(k, group), repeat_kv(v, group)

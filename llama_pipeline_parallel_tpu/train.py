@@ -382,6 +382,12 @@ def _run_training(cfg: dict) -> dict:
                     manifest.stage_layer_counts)
     else:
         manifest = StageManifest.for_config(model_cfg, mesh_cfg.pp)
+    # Packing composes with every parallelism axis: both attention backends
+    # handle segment masks at sp=1 (the exact op's pairwise test, the flash
+    # kernel's in-tile _seg_tile_mask); under sp>1 Ulysses all-gathers the
+    # mask to full length and ring rotates the kv segment slab with its k/v
+    # (pcfg.packed switches the ring's segment streams on).
+    packing = _packing_factor(cfg)
     pcfg = pl.PipelineConfig(
         num_stages=mesh_cfg.pp,
         num_microbatches=cfg.get("gradient_accumulation_steps", 1),
@@ -391,21 +397,9 @@ def _run_training(cfg: dict) -> dict:
         accum_chunks=cfg.get("gradient_accumulation_chunks", 1),
         sequence_parallel=cfg.get("sequence_parallel", "ring"),
         loss_chunks=cfg.get("loss_vocab_chunks", 1),
-        layer_counts=None if manifest.is_even else manifest.stage_layer_counts)
+        layer_counts=None if manifest.is_even else manifest.stage_layer_counts,
+        packed=packing > 1)
 
-    packing = _packing_factor(cfg)
-    if packing > 1:
-        if mesh_cfg.sp > 1 and cfg.get("sequence_parallel", "ring") != "ulysses":
-            raise ValueError(
-                "packing_factor with sp>1 requires sequence_parallel=ulysses: "
-                "the ring path drops the padding mask entirely (parallel/sp.py "
-                "passes None — segment ids would be silently discarded, "
-                "letting packed examples attend across boundaries); Ulysses "
-                "all-gathers the mask to full length, so segment pairing "
-                "stays positionally exact")
-        # both attention backends handle segment masks (the exact op's
-        # pairwise test, the flash kernel's in-tile _seg_tile_mask), so
-        # exact/flash/auto all stay valid under packing
     dataset, collator = build_dataset_and_collator(cfg, model_cfg)
     micro_batch = cfg.get("per_device_train_batch_size", 1)
     # with packing, the loader feeds pack_factor x examples per emitted row

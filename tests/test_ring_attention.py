@@ -96,6 +96,90 @@ def test_ring_flash_backend_matches(devices, monkeypatch):
                                    rtol=2e-3, atol=2e-3, err_msg=f"d{name}")
 
 
+def make_packed_segments(b, s, seed=5):
+    """Random packed rows: 2-3 segments numbered 1..k plus trailing pad
+    (the packed collator's mask contract, data/collator.py)."""
+    r = np.random.RandomState(seed)
+    seg = np.zeros((b, s), np.int32)
+    for row in range(b):
+        at = 0
+        for sid in range(1, int(r.randint(2, 4)) + 1):
+            n = int(r.randint(2, max(3, s // 3)))
+            if at + n > s - 1:
+                break
+            seg[row, at:at + n] = sid
+            at += n
+    return jnp.asarray(seg)
+
+
+def seg_loss(out, seg):
+    """Sum-of-squares over REAL positions only: the exact op softens
+    all-masked pad rows to a uniform softmax while the ring emits exact 0
+    there — both are dont-cares (pad losses are IGNORE_INDEX-masked), so the
+    comparison must not read them."""
+    real = (seg != 0)[:, :, None, None]
+    return (jnp.where(real, out.astype(jnp.float32), 0.0) ** 2).sum()
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+@pytest.mark.parametrize("backend", ["exact", "flash"])
+def test_ring_segments_match_full(devices, monkeypatch, sp, backend):
+    """Packed segment ids through the ring (the rotating seg slab) agree
+    with full-sequence exact attention's pairwise segment mask — forward and
+    input gradients, both slab backends."""
+    if backend == "flash":
+        from llama_pipeline_parallel_tpu.ops import flash_attention as fa
+
+        monkeypatch.setattr(fa, "_INTERPRET", True)
+    q, k, v = rand_qkv(b=2, s=32, h=2, hd=8, seed=11)
+    seg = make_packed_segments(b=2, s=32)
+    mesh = make_mesh(MeshConfig(sp=sp))
+
+    def local(q, k, v, seg):
+        out = ring_attention(q, k, v, seg, causal=True, backend=backend)
+        return jax.lax.psum(seg_loss(out, seg), "sp")
+
+    ring_loss = shard_map(local, mesh=mesh,
+                          in_specs=(P(None, "sp"),) * 3 + (P(None, "sp"),),
+                          out_specs=P(), check_vma=False)
+    full_loss = lambda q, k, v, seg: seg_loss(
+        attention(q, k, v, seg, causal=True), seg)
+
+    vr, gr = jax.value_and_grad(jax.jit(ring_loss), (0, 1, 2))(q, k, v, seg)
+    vf, gf = jax.value_and_grad(full_loss, (0, 1, 2))(q, k, v, seg)
+    np.testing.assert_allclose(float(vr), float(vf), rtol=2e-4)
+    for name, a, b in zip("qkv", gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3, err_msg=f"d{name}")
+
+
+def test_ring_segment_isolation(devices):
+    """A segment's outputs are identical whether or not OTHER segments share
+    the row — packed examples can't leak across boundaries through the ring
+    (including across slab rotations: segments straddle the sp=4 slab cuts)."""
+    b, s, h, hd = 1, 32, 2, 8
+    q, k, v = rand_qkv(b=b, s=s, h=h, hd=hd, seed=13)
+    mesh = make_mesh(MeshConfig(sp=4))
+
+    def run(seg):
+        fn = shard_map(
+            lambda q, k, v, seg: ring_attention(q, k, v, seg, causal=True),
+            mesh=mesh, in_specs=(P(None, "sp"),) * 4,
+            out_specs=P(None, "sp"), check_vma=False)
+        return np.asarray(jax.jit(fn)(q, k, v, seg))
+
+    seg_ab = np.zeros((b, s), np.int32)
+    seg_ab[0, :12], seg_ab[0, 12:26] = 1, 2   # crosses the 8-wide slab cuts
+    # the SECOND segment is the leak-sensitive one: causality alone would let
+    # its queries (positions 12..25) see segment 1's keys (positions 0..11)
+    alone = np.zeros((b, s), np.int32)
+    alone[0, 12:26] = 1
+    out_packed = run(jnp.asarray(seg_ab))
+    out_alone = run(jnp.asarray(alone))
+    np.testing.assert_allclose(out_packed[0, 12:26], out_alone[0, 12:26],
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_ring_requires_expanded_kv(devices):
     q, k, v = rand_qkv(b=1, s=32, h=4, hd=8)
     k2 = k[:, :, :2]
