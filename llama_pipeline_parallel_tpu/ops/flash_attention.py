@@ -59,6 +59,22 @@ def _block_sizes(sq: int, skv: int, block_q: int, block_k: int) -> tuple[int, in
     return bq, bk
 
 
+def _auto_block(s: int, preferred: int = 1024) -> int:
+    """Largest 128-aligned block <= preferred that tiles a length-`s`
+    sequence (seq 1536 runs with 768 blocks instead of abandoning the flash
+    path — round-3 verdict item 5). A block that already tiles (including
+    any explicitly-passed or sub-128 clamped one) is returned unchanged;
+    lengths no candidate divides (e.g. 1537) return the 128 floor and fall
+    through to `_block_sizes`' divisibility error."""
+    b = min(preferred, s)
+    if s % b == 0:
+        return b
+    for cand in range(b - b % 128, 127, -128):
+        if s % cand == 0:
+            return cand
+    return 128
+
+
 def _causal_tile_mask(s, qi, ki, block_q, block_k, q_offset, kv_offset):
     qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
@@ -416,10 +432,13 @@ def flash_attention(
     causal: bool = True,
     q_offset: int = 0,
     kv_offset: int = 0,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
 ) -> jnp.ndarray:
     """Drop-in AttnFn (same [b, s, h, hd] signature as ops.attention.attention).
+
+    block_q/block_k default to the largest tiling block <= 1024 for the
+    actual q/kv lengths (`_auto_block`); pass explicit sizes to pin them.
 
     padding_mask semantics match the exact op (ops/attention.py): it carries
     SEGMENT IDS (0 = pad, packed examples numbered 1..k). In self-attention
@@ -431,6 +450,10 @@ def flash_attention(
     """
     if q.shape[2] % k.shape[2]:
         raise ValueError(f"q heads {q.shape[2]} not a multiple of kv heads {k.shape[2]}")
+    if block_q is None:
+        block_q = _auto_block(q.shape[1])
+    if block_k is None:
+        block_k = _auto_block(k.shape[1])
     scale = q.shape[-1] ** -0.5
     segments = None
     if padding_mask is not None and q.shape[1] == k.shape[1]:

@@ -95,7 +95,10 @@ def _slab_bwd_exact(q, k, v, do, lse, delta, *, causal, scale, q_offset, kv_offs
 
 def _slab_fwd(backend, q, k, v, *, seg_q=None, seg_kv=None, **kw):
     if backend == "flash":
-        return fa._fwd(q, k, v, block_q=1024, block_k=1024,
+        # adaptive blocks: a 6144-seq sp=4 run has 1536-long slabs — tile
+        # with 512 blocks instead of abandoning the flash backend
+        return fa._fwd(q, k, v, block_q=fa._auto_block(q.shape[2]),
+                       block_k=fa._auto_block(k.shape[2]),
                        segments_q=seg_q, segments_kv=seg_kv, **kw)
     return _slab_fwd_exact(q, k, v, seg_q=seg_q, seg_kv=seg_kv, **kw)
 
@@ -103,7 +106,9 @@ def _slab_fwd(backend, q, k, v, *, seg_q=None, seg_kv=None, **kw):
 def _slab_bwd(backend, q, k, v, do, lse, delta, *, seg_q=None, seg_kv=None, **kw):
     if backend == "flash":
         # fa._bwd consumes/produces [b,h,s,hd] with full heads
-        return fa._bwd(q, k, v, delta, lse, do, block_q=1024, block_k=1024,
+        return fa._bwd(q, k, v, delta, lse, do,
+                       block_q=fa._auto_block(q.shape[2]),
+                       block_k=fa._auto_block(k.shape[2]),
                        segments_q=seg_q, segments_kv=seg_kv, **kw)
     return _slab_bwd_exact(q, k, v, do, lse, delta, seg_q=seg_q, seg_kv=seg_kv, **kw)
 

@@ -146,16 +146,31 @@ def _flash_without_mask(q, k, v, padding_mask=None, *, causal=True):
 _AUTO_ATTN_CACHE: dict = {}
 
 
-def _measure_attention(model_cfg: LlamaConfig, seq_len: int) -> Any:
+def _measure_segments(batch: int, seq_len: int) -> jnp.ndarray:
+    """Representative packed-row segment ids for the auto measurement: four
+    equal segments covering ~4/5 of the row, then a genuine pad tail — so
+    the timing includes the kernels' fully-masked-pad skip path the real
+    packed run hits."""
+    seg = np.zeros((batch, seq_len), np.int32)
+    fifth = max(seq_len // 5, 1)
+    for i in range(4):
+        seg[:, i * fifth:(i + 1) * fifth] = i + 1
+    return jnp.asarray(seg)
+
+
+def _measure_attention(model_cfg: LlamaConfig, seq_len: int,
+                       micro_batch: int = 1, packed: bool = False) -> Any:
     """Time exact vs flash (fwd+bwd, jitted, value-fetch barrier) at this
-    run's shape ON THE DEVICE and return the faster — `auto` picks by
-    measurement, not by threshold folklore. Cached per shape; any failure
-    falls back to the exact path."""
+    run's ACTUAL (microbatch, seq) shape ON THE DEVICE — with segment-id
+    streams when the run packs sequences, since those change the flash
+    kernel's work — and return the faster. `auto` picks by measurement, not
+    by threshold folklore. Cached per shape; any failure falls back to the
+    exact path."""
     from llama_pipeline_parallel_tpu.ops.attention import attention
     from llama_pipeline_parallel_tpu.ops.flash_attention import flash_attention
 
-    key = (seq_len, model_cfg.num_attention_heads, model_cfg.kv_heads,
-           model_cfg.head_dim)
+    key = (seq_len, micro_batch, packed, model_cfg.num_attention_heads,
+           model_cfg.kv_heads, model_cfg.head_dim)
     if key in _AUTO_ATTN_CACHE:
         return _AUTO_ATTN_CACHE[key]
 
@@ -166,12 +181,14 @@ def _measure_attention(model_cfg: LlamaConfig, seq_len: int) -> Any:
             rng = np.random.RandomState(0)
             h, hkv, hd = (model_cfg.num_attention_heads, model_cfg.kv_heads,
                           model_cfg.head_dim)
-            q = jnp.asarray(rng.randn(1, seq_len, h, hd), jnp.bfloat16)
-            k = jnp.asarray(rng.randn(1, seq_len, hkv, hd), jnp.bfloat16)
-            v = jnp.asarray(rng.randn(1, seq_len, hkv, hd), jnp.bfloat16)
+            b = max(int(micro_batch), 1)
+            q = jnp.asarray(rng.randn(b, seq_len, h, hd), jnp.bfloat16)
+            k = jnp.asarray(rng.randn(b, seq_len, hkv, hd), jnp.bfloat16)
+            v = jnp.asarray(rng.randn(b, seq_len, hkv, hd), jnp.bfloat16)
+            mask = _measure_segments(b, seq_len) if packed else None
 
             def time_one(fn):
-                loss = lambda q, k, v: (fn(q, k, v, None, causal=True)
+                loss = lambda q, k, v: (fn(q, k, v, mask, causal=True)
                                         .astype(jnp.float32) ** 2).sum()
                 step = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
                 float(step(q, k, v)[0])  # compile + barrier (value fetch)
@@ -182,8 +199,9 @@ def _measure_attention(model_cfg: LlamaConfig, seq_len: int) -> Any:
 
             t_exact, t_flash = time_one(attention), time_one(flash_attention)
             winner = flash_attention if t_flash < t_exact else attention
-            logger.info("attention=auto @ seq %d: exact %.2fms, flash %.2fms -> %s",
-                        seq_len, 1e3 * t_exact, 1e3 * t_flash,
+            logger.info("attention=auto @ batch %d seq %d packed=%s: "
+                        "exact %.2fms, flash %.2fms -> %s",
+                        b, seq_len, packed, 1e3 * t_exact, 1e3 * t_flash,
                         "flash" if winner is flash_attention else "exact")
             return winner
         except Exception as e:
@@ -210,20 +228,26 @@ def _measure_attention(model_cfg: LlamaConfig, seq_len: int) -> Any:
 def select_attention(impl: str, seq_length: int, mesh,
                      sequence_parallel: str = "ring",
                      model_cfg: LlamaConfig | None = None,
-                     packed: bool = False) -> Any:
+                     packed: bool = False,
+                     micro_batch: int = 1) -> Any:
     """'exact' | 'flash' | 'auto'. The reference tried and failed to enable
     flash attention (README.md:141-143); here `auto` MEASURES both paths on
-    the device at the run's shape and keeps the faster.
+    the device at the run's (microbatch, seq) shape — with segment streams
+    when packed — and keeps the faster.
 
     `seq_length` must be the ACTUAL batch sequence length (probe the
-    collator), not a config guess. The flash kernel's real tiling rule
-    (ops/flash_attention.py `_block_sizes`: blocks clamp to the sequence):
-    any length under 1024 tiles, longer ones need a 1024 multiple — checked
-    against the length the kernel actually SEES, which under ring sequence
-    parallelism is the per-slab seq/sp (Ulysses re-shards to the full
-    sequence, so there it stays seq)."""
+    collator), not a config guess. The flash kernel's tiling rule is
+    adaptive (ops/flash_attention.py `_auto_block`: largest block <= 1024
+    that divides the length, halving to 128): seq 1536 tiles with 512
+    blocks; only lengths nothing divides (odd sizes) need the exact path.
+    Checked against the length the kernel actually SEES, which under ring
+    sequence parallelism is the per-slab seq/sp (Ulysses re-shards to the
+    full sequence, so there it stays seq)."""
     from llama_pipeline_parallel_tpu.ops.attention import attention
-    from llama_pipeline_parallel_tpu.ops.flash_attention import flash_attention
+    from llama_pipeline_parallel_tpu.ops.flash_attention import (
+        _auto_block,
+        flash_attention,
+    )
 
     def finish(fn):
         """Unpacked single-chip-sequence flash runs skip the kernel's segment
@@ -245,18 +269,20 @@ def select_attention(impl: str, seq_length: int, mesh,
         kernel_len = seq_length // sp if (sp > 1 and sequence_parallel == "ring") \
             else seq_length
         on_tpu = mesh.devices.ravel()[0].platform == "tpu"
-        tiles = kernel_len < 1024 or kernel_len % 1024 == 0
+        tiles = kernel_len % _auto_block(kernel_len) == 0
         if not on_tpu:
             return attention  # flash interpret mode off-TPU is far slower
         if not tiles:
             logger.warning(
                 "attention=auto: kernel sequence length %d (seq %d / sp slab) "
-                "does not tile into flash blocks; using the exact path (pad to "
-                "a 1024 multiple to enable flash)", kernel_len, seq_length)
+                "does not tile into any flash block size {1024,512,256,128}; "
+                "using the exact path (pad to a 128 multiple to enable flash)",
+                kernel_len, seq_length)
             return attention
         if model_cfg is None:
             return finish(flash_attention) if kernel_len >= 2048 else attention
-        return finish(_measure_attention(model_cfg, kernel_len))
+        return finish(_measure_attention(model_cfg, kernel_len,
+                                         micro_batch=micro_batch, packed=packed))
     raise ValueError(f"unknown attention impl {impl!r} (use exact|flash|auto)")
 
 
@@ -480,7 +506,8 @@ def _run_training(cfg: dict) -> dict:
     attn_fn = select_attention(cfg.get("attention", "auto"), seq_length, mesh,
                                sequence_parallel=cfg.get("sequence_parallel", "ring"),
                                model_cfg=model_cfg,
-                               packed=_packing_factor(cfg) > 1)
+                               packed=_packing_factor(cfg) > 1,
+                               micro_batch=micro_batch)
     step_fn = ts.make_train_step(mesh, model_cfg, pcfg, tx, schedule,
                                  stacked_template, attn_fn=attn_fn)
 
@@ -813,7 +840,8 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
     attn_fn = select_attention(cfg.get("attention", "auto"), seq_length, mesh,
                                sequence_parallel=cfg.get("sequence_parallel", "ring"),
                                model_cfg=model_cfg,
-                               packed=_packing_factor(cfg) > 1)
+                               packed=_packing_factor(cfg) > 1,
+                               micro_batch=cfg.get("per_device_train_batch_size", 1))
     grad_fn = jax.jit(pl.make_pipeline_loss_and_grad(
         mesh, model_cfg, pcfg, stacked_template, attn_fn=attn_fn))
 

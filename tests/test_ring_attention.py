@@ -180,6 +180,27 @@ def test_ring_segment_isolation(devices):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_ring_flash_adaptive_slab_blocks(devices, monkeypatch):
+    """A 6144-seq sp=4 run hands the flash backend 1536-long slabs — not a
+    1024 multiple. The adaptive block selection (fa._auto_block -> 512)
+    keeps the flash path instead of erroring (round-3 verdict #5); forward
+    parity vs full exact attention (interpret mode, minimal heads to bound
+    CPU cost)."""
+    from llama_pipeline_parallel_tpu.ops import flash_attention as fa
+
+    monkeypatch.setattr(fa, "_INTERPRET", True)
+    q, k, v = rand_qkv(b=1, s=6144, h=1, hd=8, seed=9)
+    full = attention(q, k, v, None, causal=True)
+    mesh = make_mesh(MeshConfig(sp=4))
+    fn = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, causal=True, backend="flash"),
+        mesh=mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
+        check_vma=False)
+    out = jax.jit(fn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_ring_requires_expanded_kv(devices):
     q, k, v = rand_qkv(b=1, s=32, h=4, hd=8)
     k2 = k[:, :, :2]
