@@ -96,7 +96,7 @@ def _slab_bwd_exact(q, k, v, do, lse, delta, *, causal, scale, q_offset, kv_offs
 def _slab_fwd(backend, q, k, v, *, seg_q=None, seg_kv=None, **kw):
     if backend == "flash":
         # adaptive blocks: a 6144-seq sp=4 run has 1536-long slabs — tile
-        # with 512 blocks instead of abandoning the flash backend
+        # with 768 blocks instead of abandoning the flash backend
         return fa._fwd(q, k, v, block_q=fa._auto_block(q.shape[2]),
                        block_k=fa._auto_block(k.shape[2]),
                        segments_q=seg_q, segments_kv=seg_kv, **kw)

@@ -91,6 +91,42 @@ def _packing_factor(cfg: dict) -> int:
     return int(cfg.get("packing_factor", 1) or 1)
 
 
+def build_manifest(cfg: dict, model_cfg: LlamaConfig, pp: int) -> StageManifest:
+    """Stage partition policy, shared by the trainer and tools/preflight.py
+    (the preflight must compile the SAME program the trainer runs): explicit
+    per-stage layer_counts > cost-balanced (`stage_balance: cost`, the
+    SURVEY §7.3-item-2 MFU lever) > even split. Indivisible layer counts
+    fall back to cost-balanced automatically."""
+    if cfg.get("layer_counts"):
+        return StageManifest(num_layers=model_cfg.num_hidden_layers,
+                             num_stages=pp,
+                             layer_counts=tuple(cfg["layer_counts"]))
+    if (cfg.get("stage_balance", "even") == "cost"
+            or model_cfg.num_hidden_layers % pp):
+        manifest = StageManifest.balanced(model_cfg, pp)
+        logger.info("stage partition (cost-balanced): %s",
+                    manifest.stage_layer_counts)
+        return manifest
+    return StageManifest.for_config(model_cfg, pp)
+
+
+def build_pipeline_config(cfg: dict, mesh_cfg: Any, manifest: StageManifest
+                          ) -> "pl.PipelineConfig":
+    """PipelineConfig from the run config — one construction for the trainer
+    and tools/preflight.py."""
+    return pl.PipelineConfig(
+        num_stages=mesh_cfg.pp,
+        num_microbatches=cfg.get("gradient_accumulation_steps", 1),
+        remat=cfg.get("activation_checkpointing", True),
+        remat_policy=cfg.get("remat_policy", "nothing_saveable"),
+        schedule=cfg.get("pipeline_schedule", "1f1b"),
+        accum_chunks=cfg.get("gradient_accumulation_chunks", 1),
+        sequence_parallel=cfg.get("sequence_parallel", "ring"),
+        loss_chunks=cfg.get("loss_vocab_chunks", 1),
+        layer_counts=None if manifest.is_even else manifest.stage_layer_counts,
+        packed=_packing_factor(cfg) > 1)
+
+
 def build_dataset_and_collator(cfg: dict, model_cfg: LlamaConfig) -> tuple[Any, Any]:
     packing = _packing_factor(cfg)
     data_cfg = cfg.get("dataset")
@@ -237,9 +273,9 @@ def select_attention(impl: str, seq_length: int, mesh,
 
     `seq_length` must be the ACTUAL batch sequence length (probe the
     collator), not a config guess. The flash kernel's tiling rule is
-    adaptive (ops/flash_attention.py `_auto_block`: largest block <= 1024
-    that divides the length, halving to 128): seq 1536 tiles with 512
-    blocks; only lengths nothing divides (odd sizes) need the exact path.
+    adaptive (ops/flash_attention.py `_auto_block`: the largest 128-multiple
+    <= 1024 that divides the length): seq 1536 tiles with 768 blocks, 1280
+    with 640; only lengths no 128-multiple divides need the exact path.
     Checked against the length the kernel actually SEES, which under ring
     sequence parallelism is the per-slab seq/sp (Ulysses re-shards to the
     full sequence, so there it stays seq)."""
@@ -275,8 +311,8 @@ def select_attention(impl: str, seq_length: int, mesh,
         if not tiles:
             logger.warning(
                 "attention=auto: kernel sequence length %d (seq %d / sp slab) "
-                "does not tile into any flash block size {1024,512,256,128}; "
-                "using the exact path (pad to a 128 multiple to enable flash)",
+                "is not divisible by any 128-multiple block <= 1024; using "
+                "the exact path (pad to a 128 multiple to enable flash)",
                 kernel_len, seq_length)
             return attention
         if model_cfg is None:
@@ -394,37 +430,14 @@ def _run_training(cfg: dict) -> dict:
     mesh_cfg = MeshConfig(**cfg.get("mesh", {}))
     mesh = make_mesh(mesh_cfg)
     model_cfg = build_model_config(cfg["model"])
-    # Stage partition: explicit per-stage layer_counts > cost-balanced
-    # (`stage_balance: cost`, the SURVEY §7.3-item-2 MFU lever) > even split.
-    # Indivisible layer counts fall back to cost-balanced automatically.
-    if cfg.get("layer_counts"):
-        manifest = StageManifest(num_layers=model_cfg.num_hidden_layers,
-                                 num_stages=mesh_cfg.pp,
-                                 layer_counts=tuple(cfg["layer_counts"]))
-    elif (cfg.get("stage_balance", "even") == "cost"
-          or model_cfg.num_hidden_layers % mesh_cfg.pp):
-        manifest = StageManifest.balanced(model_cfg, mesh_cfg.pp)
-        logger.info("stage partition (cost-balanced): %s",
-                    manifest.stage_layer_counts)
-    else:
-        manifest = StageManifest.for_config(model_cfg, mesh_cfg.pp)
+    manifest = build_manifest(cfg, model_cfg, mesh_cfg.pp)
     # Packing composes with every parallelism axis: both attention backends
     # handle segment masks at sp=1 (the exact op's pairwise test, the flash
     # kernel's in-tile _seg_tile_mask); under sp>1 Ulysses all-gathers the
     # mask to full length and ring rotates the kv segment slab with its k/v
     # (pcfg.packed switches the ring's segment streams on).
     packing = _packing_factor(cfg)
-    pcfg = pl.PipelineConfig(
-        num_stages=mesh_cfg.pp,
-        num_microbatches=cfg.get("gradient_accumulation_steps", 1),
-        remat=cfg.get("activation_checkpointing", True),
-        remat_policy=cfg.get("remat_policy", "nothing_saveable"),
-        schedule=cfg.get("pipeline_schedule", "1f1b"),
-        accum_chunks=cfg.get("gradient_accumulation_chunks", 1),
-        sequence_parallel=cfg.get("sequence_parallel", "ring"),
-        loss_chunks=cfg.get("loss_vocab_chunks", 1),
-        layer_counts=None if manifest.is_even else manifest.stage_layer_counts,
-        packed=packing > 1)
+    pcfg = build_pipeline_config(cfg, mesh_cfg, manifest)
 
     dataset, collator = build_dataset_and_collator(cfg, model_cfg)
     micro_batch = cfg.get("per_device_train_batch_size", 1)
@@ -537,7 +550,8 @@ def _run_training(cfg: dict) -> dict:
     try:
         final_loss, preempted_at = _train_loop(
             cfg, model_cfg, mesh, loader, seq_length,
-            resume_step, end_step, do_step, do_save, do_eval)
+            resume_step, end_step, do_step, do_save, do_eval,
+            extra_scalars=_packing_scalars(collator))
     except BaseException:
         # join the in-flight commit, but never let ITS failure replace the
         # training exception that actually killed the run
@@ -624,14 +638,31 @@ def _make_evaluator(cfg, mesh, model_cfg, pcfg, stacked_template, attn_fn,
     return run_eval
 
 
+def _packing_scalars(collator) -> Any:
+    """Metrics hook surfacing the packed collator's cumulative drop counters
+    (round-3 weak #4: drops warned once per process and never reached the
+    metrics stream). Counters are this process's own loader traffic — on a
+    pod each host packs its dp shards, so process 0's rate is a same-
+    distribution sample, not the global count."""
+    if not isinstance(collator, PackedCausalLMCollator):
+        return None
+
+    def scalars():
+        return {"packing_dropped_total": collator.dropped_total,
+                "packing_drop_rate": round(collator.drop_rate(), 4)}
+
+    return scalars
+
+
 def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
-                do_step, do_save, do_eval=None) -> float:
+                do_step, do_save, do_eval=None, extra_scalars=None) -> tuple:
     """The shared step/log/save/profile loop for both optimizer paths.
 
     `do_step(batch) -> (loss_scalar, scalars_thunk)`; the thunk is only called
     at logging boundaries so the hot loop never blocks on a D2H sync.
     `do_save(step)` writes a full checkpoint. `do_eval() -> float` (optional)
-    runs every `eval_steps`.
+    runs every `eval_steps`. `extra_scalars() -> dict` (optional) contributes
+    host-side counters (e.g. packing drop rate) to every metrics line.
     """
     output_dir = cfg["output_dir"]
     # Scalars are replicated across processes: process 0 writes for the pod
@@ -720,7 +751,8 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
             if (step + 1) % logging_steps == 0 or step + 1 == end_step:
                 final_loss = float(losses[-1])
                 writer.log(step + 1, {"loss": float(np.mean([float(l) for l in losses])),
-                                      **scalars_thunk(), **meter.read_and_reset()})
+                                      **scalars_thunk(), **meter.read_and_reset(),
+                                      **(extra_scalars() if extra_scalars else {})})
                 losses.clear()
             eval_steps = cfg.get("eval_steps", 0)
             if do_eval is not None and eval_steps and (step + 1) % eval_steps == 0:
@@ -868,6 +900,7 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
                               attn_fn, lambda: device_params_box[0])
     final_loss, preempted_at = _train_loop(
         cfg, model_cfg, mesh, loader, seq_length,
-        resume_step, end_step, do_step, do_save, do_eval)
+        resume_step, end_step, do_step, do_save, do_eval,
+        extra_scalars=_packing_scalars(collator))
     return _summarize(final_loss, preempted_at, end_step, len(loader),
                       output_dir)

@@ -121,7 +121,7 @@ def test_auto_block_selection():
 
 def test_seq_1536_runs_flash_with_adaptive_blocks():
     """seq 1536 (not a 1024 multiple — the round-3 silent fallback case) now
-    tiles with auto-selected 512 blocks: fwd + grads parity vs exact."""
+    tiles with auto-selected 768 blocks: fwd + grads parity vs exact."""
     q, k, v = rand_qkv(b=1, sq=1536, skv=1536, h=1, hd=8)
     ref = attention(q, k, v, None, causal=True)
     out = fa.flash_attention(q, k, v, causal=True)  # blocks auto-selected
@@ -159,7 +159,7 @@ def test_select_attention_tiling_rule(devices):
     mesh = make_mesh(MeshConfig(sp=4))
     # CPU mesh -> always exact, but the call must accept every shape/strategy
     # including the previously-rejected non-1024-multiple slabs (6144/sp=4 ->
-    # 1536-long ring slabs now tile with 512 blocks)
+    # 1536-long ring slabs now tile with 768 blocks)
     for seq, strategy in ((512, "ring"), (4096, "ring"), (6144, "ring"),
                           (1536, "ulysses"), (6144, "ulysses")):
         assert select_attention("auto", seq, mesh, strategy) is attention

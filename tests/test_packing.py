@@ -63,7 +63,8 @@ def test_packed_forward_matches_separate_sequences():
 def test_packed_collator_layout():
     tok = FakeTokenizer()
     # lengths (whitespace tokens incl. eos glued to the last target word):
-    # 5, 3, 4, 5 -> first-fit at L=10: row0 = [5, 3], row1 = [4, 5]
+    # 5, 3, 4, 5 -> first-fit-DECREASING at L=10 places 5, 5, 4, 3:
+    # row0 = [5, 5], row1 = [4, 3]
     coll = PackedCausalLMCollator(tok, max_seq_length=10, pack_factor=2)
     examples = [{"inputs": "a b c", "targets": "d e"},
                 {"inputs": "f g", "targets": "h"},
@@ -116,6 +117,71 @@ def test_packed_collator_drops_overflow():
     batch = coll(examples)  # 1 row of 8; only one 8-token example fits
     assert batch["input_ids"].shape == (1, 8)
     assert coll.dropped_total == 3
+    assert coll.packed_total == 1
+    assert coll.drop_rate() == pytest.approx(0.75)
+
+
+def test_ffd_beats_arrival_order_first_fit():
+    """First-fit-decreasing packs batches that arrival-order first-fit
+    drops from: lengths [3, 3, 7, 7] at L=10 — arrival order fills row0
+    with the two short examples and can only place one 7; FFD pairs each
+    long with a short. (The round-3 advisor's bias note: arrival order
+    dropped exactly the LONG examples.)"""
+    tok = FakeTokenizer()
+    coll = PackedCausalLMCollator(tok, max_seq_length=10, pack_factor=2)
+    # FakeTokenizer: token count == word count (targets glue eos to last word)
+    examples = [{"inputs": "a b", "targets": "c"},           # 3 tokens
+                {"inputs": "d e", "targets": "f"},           # 3 tokens
+                {"inputs": "g h i j k", "targets": "l m"},   # 7 tokens
+                {"inputs": "n o p q r", "targets": "s t"}]   # 7 tokens
+    batch = coll(examples)
+    assert coll.dropped_total == 0, "FFD must fit 7+3 per row"
+    assert batch["input_ids"].shape == (2, 10)
+    # arrival-order first-fit simulation on the same lengths drops one
+    lens, L, rows = [3, 3, 7, 7], 10, 2
+    cursors, dropped = [0] * rows, 0
+    for n in lens:
+        row = next((r for r in range(rows) if cursors[r] + n <= L), None)
+        if row is None:
+            dropped += 1
+        else:
+            cursors[row] += n
+    assert dropped == 1  # what the pre-FFD collator would have lost
+
+
+def test_ffd_fuzz_retains_more_tokens_than_arrival_order():
+    """Property fuzz: aggregated over random batches, FFD placement retains
+    MORE training tokens than arrival-order first-fit on the same lengths.
+    (Not per-trial — first-fit heuristics trade wins; and not example
+    counts — FFD deliberately keeps long examples and sheds short ones,
+    reversing the arrival-order bias the round-3 advisor flagged. Measured
+    over this seeded distribution FFD places ~5% more tokens.)"""
+    tok = FakeTokenizer()
+    r = np.random.RandomState(17)
+    words = [f"w{i}" for i in range(30)]
+    ffd_tokens = arrival_tokens = 0
+    for trial in range(30):
+        L = int(r.choice([8, 16, 24]))
+        factor = int(r.choice([2, 4]))
+        coll = PackedCausalLMCollator(tok, max_seq_length=L, pack_factor=factor)
+        n_ex = factor * int(r.randint(1, 5))
+        examples = [{"inputs": " ".join(r.choice(words, r.randint(1, 9))),
+                     "targets": " ".join(r.choice(words, r.randint(1, 9)))}
+                    for _ in range(n_ex)]
+        texts = [f"{e['inputs']} {e['targets']}</s>" for e in examples]
+        lens = [min(len(t.split()), L) for t in texts]
+        batch = coll(examples)
+        ffd_tokens += int((batch["attention_mask"] != 0).sum())
+        rows = max(n_ex // factor, 1)
+        cursors = [0] * rows
+        for n in lens:
+            row = next((q for q in range(rows) if cursors[q] + n <= L), None)
+            if row is not None:
+                cursors[row] += n
+        arrival_tokens += sum(cursors)
+    assert ffd_tokens > arrival_tokens, (
+        f"FFD retained {ffd_tokens} tokens vs arrival-order "
+        f"{arrival_tokens} — the decreasing sort stopped paying for itself")
 
 
 def test_packed_collator_fuzz_invariants():
@@ -249,12 +315,20 @@ def _packed_cfg(tmp_path, tokenizer_dir, out: str, **kw) -> dict:
 
 def test_packed_training_end_to_end(devices, tmp_path, tokenizer_dir):
     """run_training with packing_factor=2 over a real jsonl dataset and
-    tokenizer: packed rows flow through the PP=2 pipeline, loss is finite."""
+    tokenizer: packed rows flow through the PP=2 pipeline, loss is finite,
+    and the metrics stream carries the cumulative packing drop counters
+    (round-3 weak #4: drops were near-invisible)."""
     from llama_pipeline_parallel_tpu.train import run_training
 
     summary = run_training(_packed_cfg(tmp_path, tokenizer_dir, "out"))
     assert summary["final_step"] == 2
     assert np.isfinite(summary["final_loss"])
+    lines = [json.loads(l)
+             for l in open(tmp_path / "out" / "metrics.jsonl")]
+    assert lines, "no metrics written"
+    for line in lines:
+        assert "packing_dropped_total" in line
+        assert 0.0 <= line["packing_drop_rate"] <= 1.0
 
 
 def test_packed_ulysses_sp_matches_sp1(devices, tmp_path, tokenizer_dir):
