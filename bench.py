@@ -77,6 +77,10 @@ def main() -> None:
             "all_configs": {k: {"ms": round(1000 * r["dt"], 1),
                                 "tok_s": round(tps_of(r), 1)}
                             for k, r in results.items()},
+            # round-1 emitted a flat name->ms map under this key; keep it so
+            # round-over-round consumers keep parsing (ADVICE round-3)
+            "all_configs_ms": {k: round(1000 * r["dt"], 1)
+                               for k, r in results.items()},
             "model": summary_ctx["model"],
         }
 

@@ -88,19 +88,39 @@ class CheckpointManager:
         import weakref
 
         ref = weakref.ref(self)
-        atexit.register(lambda: (m := ref()) is not None and m.finalize())
+        # bounded join in the backstop: if a peer process died before the
+        # commit's host_barrier, an unbounded join would hold every surviving
+        # process's EXIT for the full barrier timeout (a crashed pod becoming
+        # a 30-minute hang per host); explicit finalize() keeps waiting
+        # forever because the caller is still alive and wants the result
+        # 600s: generous for a healthy large-model array flush (which scales
+        # with checkpoint size), but well under the commit barrier's 1800s
+        # dead-peer timeout — the wedge this bound exists to not inherit
+        atexit.register(
+            lambda: (m := ref()) is not None and m.finalize(timeout_s=600))
 
-    def finalize(self) -> None:
+    def finalize(self, timeout_s: float | None = None) -> None:
         """Block until a `save(..., blocking=False)` commit (array flush,
         meta/tag write, on_complete hook) finishes. No-op when nothing is
         pending. MUST run before process exit — the commit thread is a
         daemon precisely so a crash can't hang shutdown, which means clean
         exits have to wait for it explicitly. Re-raises a failure from the
         background commit: a failed periodic checkpoint must surface exactly
-        like a failed blocking one, not vanish into a thread traceback."""
+        like a failed blocking one, not vanish into a thread traceback.
+
+        `timeout_s` (atexit backstop only): give up after this long — log
+        and abandon the commit instead of wedging interpreter shutdown on a
+        barrier whose peers may be dead."""
         t, self._pending = self._pending, None
         if t is not None:
-            t.join()
+            t.join(timeout_s)
+            if t.is_alive():
+                logger.error(
+                    "async checkpoint commit still running after %.0fs at "
+                    "exit; abandoning it (daemon thread dies with the "
+                    "process — the checkpoint stays incomplete and resume "
+                    "will ignore it)", timeout_s)
+                return
         err, self._pending_error = self._pending_error, None
         if err is not None:
             raise RuntimeError("async checkpoint commit failed") from err

@@ -48,17 +48,37 @@ def initialize_distributed() -> None:
     global _initialized
     if _initialized:
         return
-    _initialized = True
+    # `or None`: launchers that export from unset shell vars produce empty
+    # strings, which must behave like absent (int("") dies opaquely otherwise)
+    num_processes = os.environ.get("JAX_NUM_PROCESSES") or None
+    process_id = os.environ.get("JAX_PROCESS_ID") or None
     if not any(os.environ.get(k) for k in _COORDINATOR_ENVS):
+        if num_processes is not None and int(num_processes) > 1:
+            # half-configured launcher: silently training as N independent
+            # single-process runs (duplicated data, divergent checkpoints)
+            # is the worst outcome — fail loudly instead. A 1-process export
+            # (the same wrapper serving 1..N hosts) is benign single-host.
+            raise ValueError(
+                f"JAX_NUM_PROCESSES={num_processes} but no coordinator "
+                f"address is set ({'/'.join(_COORDINATOR_ENVS)}); set one, "
+                "or unset the process variables for a single-host run")
+        _initialized = True
         return  # single-host run: nothing to initialize
-    num_processes = os.environ.get("JAX_NUM_PROCESSES")
-    process_id = os.environ.get("JAX_PROCESS_ID")
     if num_processes is not None or process_id is not None:
+        if num_processes is None or process_id is None:
+            missing = ("JAX_NUM_PROCESSES" if num_processes is None
+                       else "JAX_PROCESS_ID")
+            raise ValueError(
+                f"JAX_NUM_PROCESSES and JAX_PROCESS_ID must be set together "
+                f"for explicit distributed init; {missing} is missing")
         jax.distributed.initialize(num_processes=int(num_processes),
                                    process_id=int(process_id),
                                    cluster_detection_method="deactivate")
     else:
         jax.distributed.initialize()
+    # only now: a failed/misconfigured init must stay retryable after the
+    # caller fixes the environment
+    _initialized = True
     logger.info("jax.distributed initialized: process %d/%d",
                 jax.process_index(), jax.process_count())
 

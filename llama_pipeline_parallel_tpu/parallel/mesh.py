@@ -32,6 +32,13 @@ AXIS_PP = "pp"
 AXIS_DP = "dp"
 AXIS_TP = "tp"
 AXIS_SP = "sp"
+# Expert-parallel axis hook (SURVEY.md §2.2: MoE is out of the reference's
+# scope — dense LLaMA only — but the axis NAME is reserved so an expert
+# router can shard over it without renaming the mesh). MeshConfig accepts
+# `ep` and rejects >1 until a MoE block exists; while inert, ep is
+# deliberately EXCLUDED from ALL_AXES / world_size / axis_sizes /
+# from_world — whoever adds MoE must wire it into all four.
+AXIS_EP = "ep"
 ALL_AXES = (AXIS_PP, AXIS_DP, AXIS_SP, AXIS_TP)
 
 
@@ -48,11 +55,17 @@ class MeshConfig:
     dp: int = 1
     tp: int = 1
     sp: int = 1
+    ep: int = 1  # reserved (AXIS_EP): expert parallelism for a future MoE block
 
     def __post_init__(self) -> None:
-        for axis in ("pp", "dp", "tp", "sp"):
+        for axis in ("pp", "dp", "tp", "sp", "ep"):
             if getattr(self, axis) < 1:
                 raise ValueError(f"axis {axis} must be >= 1, got {getattr(self, axis)}")
+        if self.ep > 1:
+            raise NotImplementedError(
+                "expert parallelism (ep) is an axis-name hook only: the model "
+                "family is dense LLaMA (SURVEY.md §2.2) — add a MoE block "
+                "before sharding over AXIS_EP")
 
     @property
     def world_size(self) -> int:

@@ -850,7 +850,7 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
         # shardings (host.abstract_tree + the sharding-preserving canonical
         # reshape), Orbax restores each host's shards locally, and _scatter
         # reads only addressable shards — executed across real processes by
-        # tests/test_multiprocess.py::test_offload_resume_two_process.
+        # tests/test_multiprocess.py::test_offload_trainer_two_process_resume.
         host.load_masters(mgr.load_params(resume, stacked_template, manifest))
         m, v, step_count = mgr.load_offload_moments(resume, stacked_template,
                                                     manifest)

@@ -28,6 +28,15 @@ def test_too_many_devices(devices):
         make_mesh(MeshConfig(pp=16))
 
 
+def test_ep_axis_is_a_reserved_hook():
+    """SURVEY §2.2: the expert-parallel axis NAME exists for a future MoE
+    block, but sharding over it is rejected until one does."""
+    assert mesh_lib.AXIS_EP == "ep"
+    assert MeshConfig(ep=1).world_size == 1  # accepted, inert
+    with pytest.raises(NotImplementedError, match="expert parallelism"):
+        MeshConfig(ep=2)
+
+
 def test_stage_index_inside_shard_map(devices):
     m = make_mesh(MeshConfig(pp=4, dp=2))
 
