@@ -93,11 +93,14 @@ class CheckpointManager:
         # process's EXIT for the full barrier timeout (a crashed pod becoming
         # a 30-minute hang per host); explicit finalize() keeps waiting
         # forever because the caller is still alive and wants the result
-        # 600s: generous for a healthy large-model array flush (which scales
-        # with checkpoint size), but well under the commit barrier's 1800s
-        # dead-peer timeout — the wedge this bound exists to not inherit
+        # 600s default: generous for a healthy large-model array flush, but
+        # well under the commit barrier's 1800s dead-peer timeout — the
+        # wedge this bound exists to not inherit. Flush time scales with
+        # checkpoint size and storage speed, so very large models on slow
+        # object stores can raise it via the env knob.
+        timeout = float(os.environ.get("LPT_ATEXIT_COMMIT_TIMEOUT_S", "600"))
         atexit.register(
-            lambda: (m := ref()) is not None and m.finalize(timeout_s=600))
+            lambda: (m := ref()) is not None and m.finalize(timeout_s=timeout))
 
     def finalize(self, timeout_s: float | None = None) -> None:
         """Block until a `save(..., blocking=False)` commit (array flush,
@@ -115,9 +118,13 @@ class CheckpointManager:
         if t is not None:
             t.join(timeout_s)
             if t.is_alive():
+                # keep tracking the live commit: a later finalize()/save()
+                # must re-join THIS thread, not start a second commit racing
+                # the shared latest-tag/meta writes
+                self._pending = t
                 logger.error(
                     "async checkpoint commit still running after %.0fs at "
-                    "exit; abandoning it (daemon thread dies with the "
+                    "exit; abandoning the wait (daemon thread dies with the "
                     "process — the checkpoint stays incomplete and resume "
                     "will ignore it)", timeout_s)
                 return

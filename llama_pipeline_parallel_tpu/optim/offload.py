@@ -287,6 +287,18 @@ class HostOffloadAdamW:
         return jax.tree_util.tree_unflatten(self._treedef, vals)
 
 
+    def _cast_working(self, p: np.ndarray, dtype) -> np.ndarray:
+        """fp32 master -> working-copy dtype, on the HOST (bf16 via the
+        native RNE kernel halves H2D bytes vs uploading fp32). The ONE cast
+        policy for both the standalone and the fused step paths; always
+        allocates a fresh buffer, so uploads never alias the mutable
+        masters."""
+        import jax.numpy as jnp
+
+        if jnp.dtype(dtype) == jnp.dtype(jnp.bfloat16):
+            return _cast_bf16(p, self._native)
+        return p.astype(dtype)
+
     def device_params(self, dtype=None) -> Any:
         """The bf16 (or `dtype`) device working copy, cast on the HOST so the
         H2D transfer moves half the bytes of an fp32 upload."""
@@ -295,14 +307,10 @@ class HostOffloadAdamW:
 
         t0 = time.perf_counter()
         dtype = dtype or jnp.bfloat16
-        use_bf16 = jnp.dtype(dtype) == jnp.dtype(jnp.bfloat16)
         vals = []
         for leaf in self._leaves:
-            cast = {}
-            for s in leaf.shards.values():
-                key = _index_key(s.index)
-                cast[key] = (_cast_bf16(s.p, self._native) if use_bf16
-                             else s.p.astype(dtype))
+            cast = {_index_key(s.index): self._cast_working(s.p, dtype)
+                    for s in leaf.shards.values()}
             vals.append(leaf.assemble(cast))
         # Cast + transfer DISPATCH only: device_put returns after enqueueing,
         # so the wire time is absorbed by the next dispatched computation
@@ -312,36 +320,35 @@ class HostOffloadAdamW:
 
     # -- the step ---------------------------------------------------------
 
-    def update(self, grads_tree: Any) -> None:
-        """One clipped AdamW step on every process-local shard."""
+    def _gather_grads_and_norm(self, glvs: list) -> tuple[list, float, float]:
+        """D2H every grad shard + the clipped-AdamW scale factors.
+
+        All transfers start first (they overlap each other); each leaf's
+        norm-square kernel then runs as soon as ITS transfer lands, hiding
+        later leaves' wire time behind earlier leaves' norm compute. The
+        global norm deduplicates replicated shards by min-device ownership
+        and sums across processes with one tiny host allgather.
+        Returns (per-leaf grad dicts, lr, grad_scale)."""
         import jax
 
-        glvs = self._check_tree(grads_tree)
-        t0 = time.perf_counter()
-        # Start EVERY shard's D2H first: transfers overlap each other and the
-        # per-shard host work below (np.asarray then only waits the tail).
         for g in glvs:
             if hasattr(g, "copy_to_host_async"):
                 g.copy_to_host_async()
         grad_np: list[dict] = []
+        norm_sq = 0.0
         for leaf, g in zip(self._leaves, glvs):
             shards = leaf.grad_shards(g)
-            grad_np.append({k: np.ascontiguousarray(np.asarray(v, np.float32))
-                            for k, v in shards.items()})
-        t1 = time.perf_counter()
-
-        # Global grad norm: each distinct shard counted exactly once across
-        # the whole job (min-device ownership), then one host allreduce.
-        norm_sq = 0.0
-        for leaf, gnp in zip(self._leaves, grad_np):
+            gnp = {k: np.ascontiguousarray(np.asarray(v, np.float32))
+                   for k, v in shards.items()}
+            grad_np.append(gnp)
             for key, shard in leaf.shards.items():
                 if not shard.owner:
                     continue
-                g = gnp[key]
+                gs = gnp[key]
                 if self._native is not None:
-                    norm_sq += self._native.l2_norm_sq(_fptr(g), g.size)
+                    norm_sq += self._native.l2_norm_sq(_fptr(gs), gs.size)
                 else:
-                    norm_sq += float((g.astype(np.float64) ** 2).sum())
+                    norm_sq += float((gs.astype(np.float64) ** 2).sum())
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
@@ -353,25 +360,73 @@ class HostOffloadAdamW:
 
         self.step_count += 1
         lr = float(self._schedule(self.step_count - 1))
-        for leaf, gnp in zip(self._leaves, grad_np):
-            for key, shard in leaf.shards.items():
-                g = gnp[key]
-                if self._native is not None:
-                    self._native.adamw_step(
-                        _fptr(shard.p), _fptr(shard.m), _fptr(shard.v),
-                        _fptr(g), shard.p.size,
-                        lr, self.cfg.beta1, self.cfg.beta2, self.cfg.eps,
-                        self.cfg.weight_decay, self.step_count, grad_scale)
-                else:
-                    _adamw_numpy(shard.p, shard.m, shard.v, g, lr,
-                                 self.cfg.beta1, self.cfg.beta2, self.cfg.eps,
-                                 self.cfg.weight_decay, self.step_count,
-                                 grad_scale)
-        t2 = time.perf_counter()
-        self.last_timings.update(d2h_ms=1000 * (t1 - t0),
-                                 update_ms=1000 * (t2 - t1))
         self.last_lr = lr
         self.last_grad_norm = norm
+        return grad_np, lr, grad_scale
+
+    def _apply_shard(self, shard: _Shard, g: np.ndarray, lr: float,
+                     grad_scale: float) -> None:
+        if self._native is not None:
+            self._native.adamw_step(
+                _fptr(shard.p), _fptr(shard.m), _fptr(shard.v),
+                _fptr(g), shard.p.size,
+                lr, self.cfg.beta1, self.cfg.beta2, self.cfg.eps,
+                self.cfg.weight_decay, self.step_count, grad_scale)
+        else:
+            _adamw_numpy(shard.p, shard.m, shard.v, g, lr,
+                         self.cfg.beta1, self.cfg.beta2, self.cfg.eps,
+                         self.cfg.weight_decay, self.step_count, grad_scale)
+
+    def update(self, grads_tree: Any) -> None:
+        """One clipped AdamW step on every process-local shard."""
+        t0 = time.perf_counter()
+        grad_np, lr, grad_scale = self._gather_grads_and_norm(
+            self._check_tree(grads_tree))
+        t1 = time.perf_counter()
+        for leaf, gnp in zip(self._leaves, grad_np):
+            for key, shard in leaf.shards.items():
+                self._apply_shard(shard, gnp[key], lr, grad_scale)
+        t2 = time.perf_counter()
+        # fresh dict: a stale phase key from the OTHER step path must not
+        # linger in the metrics stream (d2h_norm_ms covers transfers AND the
+        # norm/allgather — the norm kernels overlap the transfer tail)
+        self.last_timings = {"d2h_norm_ms": 1000 * (t1 - t0),
+                             "update_ms": 1000 * (t2 - t1)}
+
+    def update_and_refresh(self, grads_tree: Any, dtype=None) -> Any:
+        """One clipped AdamW step AND the fresh device working copy, software-
+        pipelined per leaf: leaf i's bf16 cast + H2D upload are dispatched
+        the moment its shards are stepped, so the wire time of leaf i
+        overlaps leaf i+1's AdamW kernel instead of waiting for the whole
+        update (the SURVEY §7.3-item-3 stall: a serial
+        update-everything-then-upload-everything step leaves the device idle
+        for the full sum of both phases). Numerics identical to
+        `update()` + `device_params()` — same kernels, same order.
+
+        Safe against in-place master mutation: each upload reads a freshly
+        allocated cast buffer, never `shard.p` itself."""
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        grad_np, lr, grad_scale = self._gather_grads_and_norm(
+            self._check_tree(grads_tree))
+        t1 = time.perf_counter()
+        dtype = dtype or jnp.bfloat16
+        vals = []
+        for leaf, gnp in zip(self._leaves, grad_np):
+            cast = {}
+            for key, shard in leaf.shards.items():
+                self._apply_shard(shard, gnp[key], lr, grad_scale)
+                cast[key] = self._cast_working(shard.p, dtype)
+            # assemble dispatches this leaf's H2D asynchronously; the next
+            # leaf's AdamW kernels run while these bytes are on the wire
+            vals.append(leaf.assemble(cast))
+        t2 = time.perf_counter()
+        # fresh dict: no stale keys from the separate-phase path
+        self.last_timings = {"d2h_norm_ms": 1000 * (t1 - t0),
+                             "update_h2d_ms": 1000 * (t2 - t1)}
+        return jax.tree_util.tree_unflatten(self._treedef, vals)
 
     # -- checkpoint integration ------------------------------------------
 

@@ -53,15 +53,21 @@ def initialize_distributed() -> None:
     num_processes = os.environ.get("JAX_NUM_PROCESSES") or None
     process_id = os.environ.get("JAX_PROCESS_ID") or None
     if not any(os.environ.get(k) for k in _COORDINATOR_ENVS):
-        if num_processes is not None and int(num_processes) > 1:
+        multi = ((num_processes is not None and int(num_processes) > 1)
+                 # a nonzero rank is just as strong a multi-process signal
+                 # as a process count, and a launcher can export either one
+                 or (process_id is not None and int(process_id) >= 1))
+        if multi:
             # half-configured launcher: silently training as N independent
             # single-process runs (duplicated data, divergent checkpoints)
-            # is the worst outcome — fail loudly instead. A 1-process export
-            # (the same wrapper serving 1..N hosts) is benign single-host.
+            # is the worst outcome — fail loudly instead. A 1-process/rank-0
+            # export (the same wrapper serving 1..N hosts) is benign
+            # single-host.
             raise ValueError(
-                f"JAX_NUM_PROCESSES={num_processes} but no coordinator "
-                f"address is set ({'/'.join(_COORDINATOR_ENVS)}); set one, "
-                "or unset the process variables for a single-host run")
+                f"JAX_NUM_PROCESSES={num_processes}/JAX_PROCESS_ID="
+                f"{process_id} but no coordinator address is set "
+                f"({'/'.join(_COORDINATOR_ENVS)}); set one, or unset the "
+                "process variables for a single-host run")
         _initialized = True
         return  # single-host run: nothing to initialize
     if num_processes is not None or process_id is not None:

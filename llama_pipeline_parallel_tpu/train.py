@@ -881,8 +881,9 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
 
     def do_step(batch):
         loss, grads = grad_fn(device_params_box[0], form_global_batch(mesh, batch))
-        host.update(grads)
-        device_params_box[0] = host.device_params(model_cfg.dtype)
+        # fused step: per-leaf AdamW overlaps the previous leaf's bf16 cast
+        # + H2D upload instead of a serial update-all-then-upload-all
+        device_params_box[0] = host.update_and_refresh(grads, model_cfg.dtype)
         return loss, lambda: {"lr": host.last_lr,
                               "grad_norm": host.last_grad_norm,
                               **{k: round(v, 2)

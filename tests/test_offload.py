@@ -91,6 +91,39 @@ def test_sharded_masters_match_optax(tree, devices):
                                rtol=8e-3, atol=1e-5)
 
 
+def test_update_and_refresh_matches_separate_phases(tree, devices):
+    """The fused per-leaf AdamW + cast + H2D pipeline (update_and_refresh,
+    the trainer's hot path) is bit-identical to the separate
+    update() + device_params() phases — same kernels, same order — while
+    returning the same sharded working copy."""
+    mesh = make_mesh(MeshConfig(pp=2, dp=2))
+    shard_specs = {"a": P("pp"), "b": {"c": P()}}
+    put = lambda t: jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), t, shard_specs)
+    cfg = OptimizerConfig(learning_rate=1e-2, weight_decay=0.1,
+                          max_grad_norm=1.0, total_steps=100, warmup_steps=10)
+
+    h_sep = off.HostOffloadAdamW(cfg)
+    h_sep.init(put(tree))
+    h_fused = off.HostOffloadAdamW(cfg)
+    h_fused.init(put(tree))
+
+    for step in range(3):
+        g = put(grads_like(tree, step))
+        h_sep.update(g)
+        dev_sep = h_sep.device_params(jnp.bfloat16)
+        dev_fused = h_fused.update_and_refresh(g, jnp.bfloat16)
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a, np.float32), np.asarray(b, np.float32)),
+            dev_sep, dev_fused)
+        assert dev_fused["a"].sharding.spec == NamedSharding(mesh, P("pp")).spec
+    jax.tree.map(lambda x, y: np.testing.assert_array_equal(
+        np.asarray(x), np.asarray(y)),
+        h_sep.masters_tree(), h_fused.masters_tree())
+    assert h_fused.last_timings["update_h2d_ms"] >= 0
+    assert h_fused.last_grad_norm == h_sep.last_grad_norm
+
+
 def test_state_dict_roundtrip(tree):
     cfg = OptimizerConfig(learning_rate=1e-2, total_steps=50, warmup_steps=2)
     h1 = off.HostOffloadAdamW(cfg)
