@@ -1,0 +1,35 @@
+"""tools/preflight.py end to end: the AOT memory check must keep working
+(it gates the big-config ladder, docs/PREFLIGHT.md) — run as a real
+subprocess because the tool must pin XLA_FLAGS before jax's first import."""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_preflight(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "preflight.py"), *args],
+        capture_output=True, text=True, cwd=_REPO, timeout=600,
+        env={**os.environ, "PYTHONPATH": _REPO})
+
+
+def test_preflight_tiny_config_passes():
+    res = _run_preflight("--config", "conf/tiny_smoke.yaml")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "preflight OK" in res.stdout
+    assert "fused_train_step" in res.stdout
+
+
+def test_preflight_fails_on_absurd_budget():
+    """The gate must actually gate: an impossible budget exits 1 with the
+    FAIL verdict (and the offload override compiles the offload path)."""
+    res = _run_preflight("--config", "conf/tiny_smoke.yaml",
+                         "--hbm-gb", "0.000001", "optimizer_offload=true")
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "preflight FAIL" in res.stdout
+    assert "offload_loss_and_grad" in res.stdout
+    assert "host_dram_total_gib" in res.stdout

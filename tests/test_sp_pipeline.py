@@ -118,8 +118,11 @@ def test_trainer_rejects_indivisible_sp_sequence(devices, tmp_path):
 
 def test_16k_ladder_config_runs_tiny(devices, tmp_path):
     """The shipped 16k stress config (BASELINE.md ladder #5) drives the real
-    trainer end-to-end at tiny scale: same mesh axes (pp=2, sp=4), same
-    sequence_parallel=ring, tiny model/sequence via overrides."""
+    trainer end-to-end at tiny scale: every mesh axis the config uses stays
+    >1 (pp x tp x sp), same sequence_parallel=ring and offloaded optimizer,
+    tiny model/sequence via overrides. The config's full 16-device topology
+    is halved to the test mesh's 8 (pp 4 -> 2) — its real shape is backed by
+    tools/preflight.py (docs/PREFLIGHT.md) and tests/test_preflight.py."""
     from llama_pipeline_parallel_tpu.train import run_training
     from llama_pipeline_parallel_tpu.utils.config import load_config
 
@@ -127,6 +130,7 @@ def test_16k_ladder_config_runs_tiny(devices, tmp_path):
                                    "conf", "codellama_34b_16k.yaml"),
                       overrides=[
                           f"output_dir={tmp_path}",
+                          "mesh.pp=2",
                           "model.preset=tiny",
                           "model.dtype=float32",
                           "dataset.seq_length=32",
