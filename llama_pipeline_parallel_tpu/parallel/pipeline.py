@@ -250,7 +250,21 @@ def unstack_stages(params: Params, manifest: StageManifest) -> Params:
 
     def unstack_leaf(x):
         if isinstance(x, jax.ShapeDtypeStruct):
-            return jax.ShapeDtypeStruct((n,) + tuple(x.shape[2:]), x.dtype)
+            # The uneven gather reorders whole layer slices along the LEADING
+            # dim only, so trailing-dim shardings survive verbatim (the
+            # ZeRO-2 offload's dp dim lives there — dropping it here would
+            # blow a 65B resume's host DRAM back to full-size leaves);
+            # leading-dim sharding is genuinely inexpressible (the gather
+            # crosses stage-shard boundaries) and falls to replicated.
+            from jax.sharding import NamedSharding
+
+            sharding = None
+            src = getattr(x, "sharding", None)
+            if isinstance(src, NamedSharding):
+                spec = list(src.spec) + [None] * (len(x.shape) - len(src.spec))
+                sharding = NamedSharding(src.mesh, P(None, *spec[2:]))
+            return jax.ShapeDtypeStruct((n,) + tuple(x.shape[2:]), x.dtype,
+                                        sharding=sharding)
         flat = jnp.asarray(x).reshape((s * k,) + tuple(x.shape[2:]))
         return flat[flat_idx]
 

@@ -481,6 +481,11 @@ def _run_training(cfg: dict) -> dict:
     if cfg.get("optimizer_offload"):
         return _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg,
                             dataset, collator, loader, end_step, stacked_template, mgr)
+    if cfg.get("optimizer_offload_zero2"):
+        raise ValueError("optimizer_offload_zero2 requires optimizer_offload: "
+                         "true (it shards the HOST-offloaded masters/grads "
+                         "over dp; the fused optimizer already has ZeRO-1 "
+                         "sharded moments)")
 
     resume_step = 0
     resume = mgr.latest_step() if cfg.get("resume", True) else None
@@ -816,10 +821,31 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
     fresh bf16 params H2D (host-cast, half the bytes), every step. Masters
     are sharded per process: each host keeps/updates only the shards its
     devices hold (the ZeRO-offload distribution of the reference's 800 GB
-    65B state, README.md:70-71)."""
+    65B state, README.md:70-71).
+
+    `optimizer_offload_zero2: true` (dp>1): masters, moments, AND the
+    gradient outputs are additionally dp-sharded on each leaf's rightmost
+    free dim (reference ZeRO-2 `reduce_scatter: True`, conf yaml:152-159,
+    lifted to the host tier) — host DRAM, grad D2H bytes, and host AdamW
+    work all drop to 1/dp per host; the device re-gathers the bf16 working
+    copy over the dp axis once per step (ICI all-gather)."""
     from llama_pipeline_parallel_tpu.optim.offload import HostOffloadAdamW
 
     output_dir = cfg["output_dir"]
+    zero2 = bool(cfg.get("optimizer_offload_zero2"))
+    if zero2 and mesh.shape["dp"] == 1:
+        logger.info("optimizer_offload_zero2 has no effect at dp=1; "
+                    "running the plain offload layout")
+        zero2 = False
+    if zero2:
+        z2_shardings = ts.specs_to_shardings(
+            mesh, ts.zero2_param_specs(stacked_template, mesh))
+        # reshard the freshly-initialized masters-to-be dp-sharded BEFORE
+        # the host copies them out; each host then stores only 1/dp.
+        # (No donation: a replicated->sharded reshard can never alias
+        # layouts, and the dead donate only emits unusable-buffer warnings.)
+        stacked_template = jax.jit(
+            lambda p: p, out_shardings=z2_shardings)(stacked_template)
     host = HostOffloadAdamW(ocfg)
     host.init(stacked_template)
     # fp32 masters now live on the host; drop the device fp32 init copy and
@@ -874,16 +900,31 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
                                model_cfg=model_cfg,
                                packed=_packing_factor(cfg) > 1,
                                micro_batch=cfg.get("per_device_train_batch_size", 1))
-    grad_fn = jax.jit(pl.make_pipeline_loss_and_grad(
-        mesh, model_cfg, pcfg, stacked_template, attn_fn=attn_fn))
+    loss_and_grad = pl.make_pipeline_loss_and_grad(
+        mesh, model_cfg, pcfg, stacked_template, attn_fn=attn_fn)
+    if zero2:
+        # grads leave the device dp-SHARDED: GSPMD turns the shard_map's dp
+        # psum + the output constraint into a reduce-scatter, and each host
+        # then D2H-pulls only its 1/dp of every gradient tree
+        grad_fn = jax.jit(loss_and_grad, out_shardings=(None, z2_shardings))
+        # the pipeline consumes dp-REPLICATED bf16 params: re-gather the
+        # dp-sharded upload over ICI once per step
+        replicated = ts.specs_to_shardings(
+            mesh, pl.stage_param_specs(stacked_template,
+                                       tp=mesh.shape["tp"] > 1))
+        to_replicated = jax.jit(lambda p: p, out_shardings=replicated)
+    else:
+        grad_fn = jax.jit(loss_and_grad)
+        to_replicated = lambda p: p
 
-    device_params_box = [host.device_params(model_cfg.dtype)]
+    device_params_box = [to_replicated(host.device_params(model_cfg.dtype))]
 
     def do_step(batch):
         loss, grads = grad_fn(device_params_box[0], form_global_batch(mesh, batch))
         # fused step: per-leaf AdamW overlaps the previous leaf's bf16 cast
         # + H2D upload instead of a serial update-all-then-upload-all
-        device_params_box[0] = host.update_and_refresh(grads, model_cfg.dtype)
+        device_params_box[0] = to_replicated(
+            host.update_and_refresh(grads, model_cfg.dtype))
         return loss, lambda: {"lr": host.last_lr,
                               "grad_norm": host.last_grad_norm,
                               **{k: round(v, 2)

@@ -214,6 +214,27 @@ def test_async_checkpoint_stays_async_multiprocess(tmp_path):
         assert r["latest"] == 9
 
 
+def test_offload_zero2_two_process_dp4(tmp_path):
+    """ZeRO-2 offload across REAL processes with dp spanning hosts (dp=4
+    over 2 processes): masters/moments live dp-sharded so each host stores
+    and updates ONLY its own dp range, grads leave the device
+    reduce-scattered across hosts, and the loss still matches the identical
+    single-process run."""
+    base = dict(tiny_train_cfg("", mesh={"dp": 4}, optimizer_offload=True,
+                               optimizer_offload_zero2=True,
+                               learning_rate=1e-2))
+    dist = run_workers(
+        "trainer", str(tmp_path), num_processes=2, local_devices=2,
+        config=dict(base, output_dir=os.path.join(str(tmp_path), "dist")))
+    ref = run_workers(
+        "trainer", str(tmp_path), num_processes=1, local_devices=4,
+        config=dict(base, output_dir=os.path.join(str(tmp_path), "ref")))
+    assert dist[0]["final_loss"] == pytest.approx(dist[1]["final_loss"],
+                                                  rel=1e-6)
+    np.testing.assert_allclose(dist[0]["final_loss"], ref[0]["final_loss"],
+                               rtol=1e-5)
+
+
 def test_offload_trainer_two_process_resume(tmp_path):
     """The 65B config-of-record lifecycle at tiny scale across real
     processes: host-offloaded optimizer (cross-process grad-norm allgather),

@@ -65,6 +65,11 @@ def preflight(cfg: dict, hbm_gb: float) -> dict:
         select_attention,
     )
 
+    if cfg.get("optimizer_offload_zero2") and not cfg.get("optimizer_offload"):
+        # mirror the trainer's rejection (train.py) — preflight passing a
+        # config the real run refuses defeats its purpose
+        raise ValueError("optimizer_offload_zero2 requires optimizer_offload: "
+                         "true")
     mesh_cfg = MeshConfig(**cfg.get("mesh", {}))
     mesh = make_mesh(mesh_cfg)
     model_cfg = build_model_config(cfg["model"])
@@ -131,14 +136,21 @@ def preflight(cfg: dict, hbm_gb: float) -> dict:
         # params in, fp32 grads out; masters + Adam moments live in host
         # DRAM (optim/offload.py) exactly like the reference's 65B
         # ZeRO-offload run (reference conf yaml:160-162, README.md:70-71).
+        # Under optimizer_offload_zero2 the grads leave the device
+        # dp-sharded (reduce-scatter), matching the trainer's program.
         param_specs = pl.stage_param_specs(stacked_abs,
                                            tp=mesh.shape["tp"] > 1)
         bf16_abs = jax.tree.map(
             lambda a, s: jax.ShapeDtypeStruct(
                 a.shape, model_cfg.dtype, sharding=NamedSharding(mesh, s)),
             stacked_abs, param_specs)
+        out_shardings = None
+        if cfg.get("optimizer_offload_zero2") and mesh_cfg.dp > 1:
+            out_shardings = (None, ts.specs_to_shardings(
+                mesh, ts.zero2_param_specs(stacked_abs, mesh)))
         grad_fn = jax.jit(pl.make_pipeline_loss_and_grad(
-            mesh, model_cfg, pcfg, stacked_abs, attn_fn=attn_fn))
+            mesh, model_cfg, pcfg, stacked_abs, attn_fn=attn_fn),
+            out_shardings=out_shardings)
         compiled = grad_fn.lower(bf16_abs, batch_abs).compile()
     else:
         step = ts.make_train_step(mesh, model_cfg, pcfg, tx, sched, stacked_abs,
