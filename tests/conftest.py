@@ -17,7 +17,13 @@ import jax  # noqa: E402
 # overwrites jax_platforms; re-pin to cpu for the virtual 8-device mesh.
 jax.config.update("jax_platforms", "cpu")
 
+import sys  # noqa: E402
+
 import pytest  # noqa: E402
+
+# repo tools/ are plain scripts, not a package: make them importable once
+# for every test that drives one (inspect_ckpt, trace_summary, ...)
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir, "tools"))
 
 
 @pytest.fixture(scope="session")

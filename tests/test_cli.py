@@ -1,7 +1,6 @@
 """CLI + packaging pins: override forms, console-script target, kernel data."""
 
 import os
-import sys
 
 import pytest
 
@@ -63,8 +62,7 @@ def test_inspect_ckpt_tool(tmp_path, devices):
     from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
     from llama_pipeline_parallel_tpu.parallel import pipeline as pl
 
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir, "tools"))
-    import inspect_ckpt
+    import inspect_ckpt  # importable via conftest's tools/ path insert
 
     cfg = LlamaConfig.tiny(num_hidden_layers=3)
     man = StageManifest(num_layers=3, num_stages=2, layer_counts=(2, 1))

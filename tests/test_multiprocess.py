@@ -218,11 +218,13 @@ def test_offload_zero2_two_process_dp4(tmp_path):
     """ZeRO-2 offload across REAL processes with dp spanning hosts (dp=4
     over 2 processes): masters/moments live dp-sharded so each host stores
     and updates ONLY its own dp range, grads leave the device
-    reduce-scattered across hosts, and the loss still matches the identical
-    single-process run."""
+    reduce-scattered across hosts, the loss matches the identical
+    single-process run, AND the dp-sharded checkpoint round-trips across
+    processes (interrupted + resumed equals straight — the docs'
+    cross-host pin for the z2 layout)."""
     base = dict(tiny_train_cfg("", mesh={"dp": 4}, optimizer_offload=True,
                                optimizer_offload_zero2=True,
-                               learning_rate=1e-2))
+                               learning_rate=1e-2, total_steps=4))
     dist = run_workers(
         "trainer", str(tmp_path), num_processes=2, local_devices=2,
         config=dict(base, output_dir=os.path.join(str(tmp_path), "dist")))
@@ -233,6 +235,18 @@ def test_offload_zero2_two_process_dp4(tmp_path):
                                                   rel=1e-6)
     np.testing.assert_allclose(dist[0]["final_loss"], ref[0]["final_loss"],
                                rtol=1e-5)
+
+    # cross-host z2 resume: each host restores its own dp-sharded
+    # master/moment range from the checkpoint written by the first leg
+    resume_dir = os.path.join(str(tmp_path), "resume")
+    run_workers("trainer", str(tmp_path), num_processes=2, local_devices=2,
+                config=dict(base, output_dir=resume_dir, max_steps=2))
+    resumed = run_workers(
+        "trainer", str(tmp_path), num_processes=2, local_devices=2,
+        config=dict(base, output_dir=resume_dir))
+    assert resumed[0]["final_step"] == 4
+    np.testing.assert_allclose(resumed[0]["final_loss"],
+                               dist[0]["final_loss"], rtol=1e-5)
 
 
 def test_offload_trainer_two_process_resume(tmp_path):

@@ -1,0 +1,41 @@
+"""tools/trace_summary.py: the offline per-op breakdown for profiler traces
+(the first thing run after a live-chip BENCH_PROFILE capture)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import trace_summary  # importable via conftest's tools/ path insert
+
+
+@pytest.fixture(scope="module")
+def trace_dir(tmp_path_factory, devices):
+    d = str(tmp_path_factory.mktemp("trace"))
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.ones((64, 64))
+    float(f(x))  # compile outside the capture
+    jax.profiler.start_trace(d)
+    for _ in range(3):
+        float(f(x))
+    jax.profiler.stop_trace()
+    return d
+
+
+def test_summarize_finds_the_jit_ops(trace_dir, capsys):
+    trace_summary.main([trace_dir, "--top", "5"])
+    out = capsys.readouterr().out
+    assert "ms total" in out
+    assert "%" in out
+    # the jitted computation must appear on some track
+    assert "PjitFunction" in out or "dot_general" in out
+
+
+def test_track_filter_and_missing_dir(trace_dir):
+    path, trace = trace_summary.load_latest_trace(trace_dir)
+    assert path.endswith(".trace.json.gz")
+    totals, op_dur, _ = trace_summary.summarize(trace, track_filter="cpu")
+    assert totals and all("cpu" in t.lower() for t in totals)
+    totals_none, _, _ = trace_summary.summarize(trace, track_filter="tpu-v9")
+    assert not totals_none
+    with pytest.raises(FileNotFoundError, match="trace.json.gz"):
+        trace_summary.load_latest_trace(trace_dir + "-missing")

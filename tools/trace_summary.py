@@ -1,0 +1,86 @@
+"""Summarize a jax.profiler trace: top ops by accumulated duration.
+
+The per-op breakdown the MFU hunt needs (SURVEY.md §5.1) without opening
+TensorBoard/Perfetto: point it at a `BENCH_PROFILE=<dir>` output or a
+trainer `profile_steps` window (`<output_dir>/profile`) and it aggregates
+the Chrome-trace complete events from the newest capture.
+
+Usage:
+  python tools/trace_summary.py <trace_dir> [--top 15] [--track SUBSTR]
+
+`--track` filters to processes whose name contains SUBSTR (e.g. "TPU" to
+see only device tracks; default keeps every track and prints each track's
+total so device vs host time is visible side by side).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+
+
+def load_latest_trace(trace_dir: str) -> tuple[str, dict]:
+    paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                             recursive=True), key=os.path.getmtime)
+    if not paths:
+        raise FileNotFoundError(
+            f"no *.trace.json.gz under {trace_dir} (is this a jax.profiler "
+            f"output dir? expected plugins/profile/<ts>/*.trace.json.gz)")
+    with gzip.open(paths[-1], "rt") as f:
+        return paths[-1], json.load(f)
+
+
+def summarize(trace: dict, track_filter: str | None = None):
+    """-> (per-track total us, per-track op->us Counter, per-track op->count
+    Counter)."""
+    proc_names: dict = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            proc_names[e.get("pid")] = e.get("args", {}).get(
+                "name", str(e.get("pid")))
+
+    track_total: collections.Counter = collections.Counter()
+    op_dur: dict = collections.defaultdict(collections.Counter)
+    op_count: dict = collections.defaultdict(collections.Counter)
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        track = proc_names.get(e.get("pid"), str(e.get("pid")))
+        if track_filter and track_filter.lower() not in track.lower():
+            continue
+        dur = float(e.get("dur", 0.0))
+        name = e.get("name", "?")
+        track_total[track] += dur
+        op_dur[track][name] += dur
+        op_count[track][name] += 1
+    return track_total, op_dur, op_count
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("trace_dir")
+    p.add_argument("--top", type=int, default=15)
+    p.add_argument("--track", default=None,
+                   help="only tracks whose process name contains this")
+    args = p.parse_args(argv)
+
+    path, trace = load_latest_trace(args.trace_dir)
+    print(f"trace: {path}")
+    track_total, op_dur, op_count = summarize(trace, args.track)
+    if not track_total:
+        raise SystemExit("no complete events matched "
+                         f"(--track {args.track!r}); try without --track")
+    for track, total in sorted(track_total.items(), key=lambda kv: -kv[1]):
+        print(f"\n== {track}: {total / 1e3:.2f} ms total ==")
+        for name, dur in op_dur[track].most_common(args.top):
+            pct = 100 * dur / total if total else 0.0
+            print(f"  {dur / 1e3:10.2f} ms  {pct:5.1f}%  "
+                  f"x{op_count[track][name]:<5d} {name}")
+
+
+if __name__ == "__main__":
+    main()
