@@ -16,6 +16,6 @@ SparkJiao/llama-pipeline-parallel (DeepSpeed pipeline-parallel LLaMA fine-tuning
   data/flan.py)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: F401
