@@ -24,6 +24,18 @@ def test_preflight_tiny_config_passes():
     assert "fused_train_step" in res.stdout
 
 
+def test_preflight_all_sweep():
+    """--all GLOB runs every matching config in a subprocess and prints the
+    summary table (one command reproduces docs/PREFLIGHT.md)."""
+    res = _run_preflight("--all", "conf/tiny*.yaml")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "verdict" in res.stdout and "OK" in res.stdout
+    res_fail = _run_preflight("--all", "conf/tiny*.yaml",
+                              "--hbm-gb", "0.0000001")
+    assert res_fail.returncode == 1
+    assert "FAIL" in res_fail.stdout
+
+
 def test_preflight_fails_on_absurd_budget():
     """The gate must actually gate: an impossible budget exits 1 with the
     FAIL verdict (and the offload override compiles the offload path)."""

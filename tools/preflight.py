@@ -189,17 +189,76 @@ def preflight(cfg: dict, hbm_gb: float) -> dict:
     return report
 
 
+def _run_all(patterns: list[str], hbm_gb: float, overrides: list[str]) -> None:
+    """Preflight every config matching `patterns` in its own subprocess (each
+    needs a different virtual device count, fixed at jax import) and print a
+    pass/fail table — one command reproduces docs/PREFLIGHT.md."""
+    import glob as globmod
+    import re
+    import subprocess
+
+    paths = sorted({p for pat in patterns for p in globmod.glob(pat)})
+    if not paths:
+        raise SystemExit(f"no configs match {patterns!r}")
+    rows, any_fail = [], False
+    for path in paths:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--config", path,
+             "--hbm-gb", str(hbm_gb), *overrides],
+            capture_output=True, text=True)
+        peak = "?"
+        m = re.search(r"per_device_peak_gib: ([0-9.]+)", proc.stdout)
+        if m:
+            peak = m.group(1)
+        ok = proc.returncode == 0
+        any_fail |= not ok
+        rows.append((path, peak, "OK" if ok else "FAIL"))
+        print(f"{'OK  ' if ok else 'FAIL'} {path}: peak {peak} GiB",
+              flush=True)
+        if not ok and not m:  # compile error, not a budget miss: show why
+            print((proc.stdout + proc.stderr).strip()[-800:], flush=True)
+    print(f"\n{'config':<40} {'peak GiB':>9}  verdict")
+    for path, peak, verdict in rows:
+        print(f"{path:<40} {peak:>9}  {verdict}")
+    if any_fail:
+        sys.exit(1)
+
+
 def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--config", required=True)
+    p.add_argument("--config", default=None,
+                   help="one config yaml (or use --all for a sweep)")
     p.add_argument("--hbm-gb", type=float, default=95.0,
                    help="per-chip HBM budget in GiB (TPU v5p: 95)")
+    p.add_argument("--all", dest="all_globs", nargs="*", default=None,
+                   metavar="GLOB",
+                   help="preflight every config matching the GLOB pattern(s) "
+                        "(default conf/*.yaml; unquoted shell-expanded paths "
+                        "work too), one subprocess each (XLA device counts "
+                        "differ per config), and print a summary table; "
+                        "exit 1 if any fails")
     p.add_argument("overrides", nargs="*", help="key=value config overrides")
     args, unknown = p.parse_known_args(argv)
     bad = [u for u in unknown if not (u.startswith("--") and "=" in u)]
     if bad:
         p.error(f"unrecognized arguments: {' '.join(bad)}")
     args.overrides += unknown
+
+    if args.all_globs is not None:
+        if args.config:
+            p.error("--config and --all are mutually exclusive")
+        # nargs='*' greedily consumes trailing key=value overrides too:
+        # route anything that isn't a yaml path/glob back to overrides
+        globs = [g for g in args.all_globs
+                 if g.endswith((".yaml", ".yml")) or "*" in g]
+        stray = [g for g in args.all_globs if g not in globs]
+        if any("=" not in s for s in stray):
+            p.error(f"--all takes .yaml globs; got {stray}")
+        _run_all(globs or ["conf/*.yaml"], args.hbm_gb,
+                 stray + args.overrides)
+        return
+    if args.config is None:
+        p.error("--config is required (or pass --all for a sweep)")
 
     n_devices = _mesh_product(args.config, args.overrides)
     os.environ["JAX_PLATFORMS"] = "cpu"
