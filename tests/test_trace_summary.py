@@ -22,7 +22,10 @@ def trace_dir(tmp_path_factory, devices):
 
 
 def test_summarize_finds_the_jit_ops(trace_dir, capsys):
-    trace_summary.main([trace_dir, "--top", "5"])
+    # --top large enough to list every event: the assertion is about the
+    # jitted computation APPEARING, not about its rank (which varies with
+    # process warm-up noise in the host-side events)
+    trace_summary.main([trace_dir, "--top", "100"])
     out = capsys.readouterr().out
     assert "ms total" in out
     assert "%" in out
