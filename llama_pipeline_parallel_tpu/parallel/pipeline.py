@@ -37,13 +37,14 @@ Design (and why it is not a translation of DeepSpeed):
   allreduce; ZeRO-1-style opt-state sharding happens in optim/, over the same
   axis the reference shards over, conf yaml zero_optimization block).
 
-Per-tick boundary costs: under "1f1b" at tp=1, embed and the
+Per-tick boundary costs: under both schedules, embed (1f1b only) and the
 final-norm/lm-head/loss head run under `lax.cond` on the stage index, so
 ONLY the first/last stage pays them (no masked replicated compute). Under
-"gpipe", and under "1f1b" with tp>1 (tp collectives cannot sit inside a
-stage-divergent branch), they run masked on every stage each tick — one
-lm-head matmul per tick of overhead; in exchange nothing is ever collected
-into an M-sized buffer.
+tp>1 the cond moves INSIDE the vocab-parallel CE: the [d, V/tp] matmul and
+the exp/gather statistics are stage-gated while the tp collectives
+(`tp_copy` backward psum, `tp_max`, `tp_reduce`) stay unconditional — the
+no-collectives-in-divergent-branches rule constrains the collectives, not
+the matmul feeding them (see _vocab_parallel_token_loss).
 """
 
 from __future__ import annotations
@@ -314,7 +315,8 @@ def _sp_shift_labels(labels: jnp.ndarray, sp_size: int) -> jnp.ndarray:
 
 
 def _vocab_parallel_token_loss(params: Params, h: jnp.ndarray, labels: jnp.ndarray,
-                               cfg: LlamaConfig, preshifted: bool = False
+                               cfg: LlamaConfig, preshifted: bool = False,
+                               last_stage: jnp.ndarray | None = None,
                                ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Shifted CE with the lm_head vocab-sharded over tp.
 
@@ -327,33 +329,77 @@ def _vocab_parallel_token_loss(params: Params, h: jnp.ndarray, labels: jnp.ndarr
 
     `preshifted`: labels are already next-token targets aligned with h
     (the sequence-parallel form, see _sp_shift_labels).
+
+    `last_stage`: optional scalar bool. When given (the pipeline schedules),
+    the HEAVY per-shard work — the [d, V/tp] head matmul and the exp/gather
+    CE statistics — runs under `lax.cond` so only the stage that owns the
+    loss pays it; every tp COLLECTIVE (tp_copy's backward psum, tp_max,
+    tp_reduce) stays outside the cond and executes stage-uniformly, which is
+    what the no-collectives-in-divergent-branches rule actually constrains
+    (the psum participants are the tp peers of ONE pp stage, but keeping
+    collectives unconditional makes uniformity true by construction). Skipped
+    stages feed neutral operands (z=1, target=0) into the psums so no
+    inf/nan intermediate ever exists, even masked. The reference pays the
+    head only on the last stage by construction
+    (models/llama_ds_mp_wrap.py:191-195); this recovers that property under
+    tp>1. Returns (0, count) on skipped stages.
     """
     from llama_pipeline_parallel_tpu.parallel.tp import tp_copy, tp_max, tp_reduce
 
     head_local = params["lm_head"].astype(cfg.dtype)  # [d, V/n] local shard
-    # column-parallel matmul: replicated h fans into vocab shards, so dh must
-    # be psum'd across tp in backward (the Megatron f operator)
-    logits = (tp_copy(h, AXIS_TP) @ head_local).astype(jnp.float32)  # [b, s, V/n]
-    if preshifted:
-        shift_logits, shift_labels = logits, labels
-    else:
-        shift_logits, shift_labels = logits[:, :-1, :], labels[:, 1:]
-    valid = shift_labels != llama.IGNORE_INDEX
-
-    v_local = shift_logits.shape[-1]
+    # column-parallel matmul input: replicated h fans into vocab shards, so dh
+    # must be psum'd across tp in backward (the Megatron f operator). Must sit
+    # OUTSIDE any stage-divergent cond: its backward psum has to run on every
+    # stage (zeros flow from skipped stages' cond transpose).
+    hc = tp_copy(h, AXIS_TP)
+    if not preshifted:
+        hc, labels = hc[:, :-1, :], labels[:, 1:]
+    valid = labels != llama.IGNORE_INDEX
+    v_local = head_local.shape[1]
     offset = jax.lax.axis_index(AXIS_TP) * v_local
 
-    m = tp_max(jax.lax.stop_gradient(shift_logits.max(axis=-1)), AXIS_TP)  # [b, s-1]
-    z = tp_reduce(jnp.exp(shift_logits - m[..., None]).sum(axis=-1), AXIS_TP)
+    def _logits(hc_, w):
+        lg = (hc_ @ w).astype(jnp.float32)  # [b, s, V/n]
+        # local row-max computed in-branch so skipped stages don't even scan
+        # their zeros buffer
+        return lg, jax.lax.stop_gradient(lg.max(axis=-1))
 
-    local_idx = jnp.where(valid, shift_labels, 0) - offset
-    owned = (local_idx >= 0) & (local_idx < v_local) & valid
-    safe_idx = jnp.clip(local_idx, 0, v_local - 1)
-    picked = jnp.take_along_axis(shift_logits, safe_idx[..., None], axis=-1)[..., 0]
-    target = tp_reduce(jnp.where(owned, picked, 0.0), AXIS_TP)
+    if last_stage is None:
+        logits, m_local = _logits(hc, head_local)
+    else:
+        logits, m_local = jax.lax.cond(
+            last_stage, _logits,
+            lambda hc_, w: (jnp.zeros(hc_.shape[:-1] + (v_local,), jnp.float32),
+                            jnp.zeros(hc_.shape[:-1], jnp.float32)),
+            hc, head_local)
 
+    m = tp_max(m_local, AXIS_TP)  # [b, s]
+
+    def _stats(logits_, m_):
+        z_local = jnp.exp(logits_ - m_[..., None]).sum(axis=-1)
+        local_idx = jnp.where(valid, labels, 0) - offset
+        owned = (local_idx >= 0) & (local_idx < v_local) & valid
+        safe_idx = jnp.clip(local_idx, 0, v_local - 1)
+        picked = jnp.take_along_axis(logits_, safe_idx[..., None], axis=-1)[..., 0]
+        return z_local, jnp.where(owned, picked, 0.0)
+
+    if last_stage is None:
+        z_local, t_local = _stats(logits, m)
+    else:
+        z_local, t_local = jax.lax.cond(
+            last_stage, _stats,
+            # ones (not zeros) for z: keeps log(z) finite on skipped stages so
+            # no inf/nan exists anywhere, even where-masked out
+            lambda logits_, m_: (jnp.ones_like(m_), jnp.zeros_like(m_)),
+            logits, m)
+
+    z = tp_reduce(z_local, AXIS_TP)
+    target = tp_reduce(t_local, AXIS_TP)
     token_loss = (m + jnp.log(z)) - target
-    return jnp.where(valid, token_loss, 0.0).sum(), valid.sum()
+    loss_sum = jnp.where(valid, token_loss, 0.0).sum()
+    if last_stage is not None:
+        loss_sum = jnp.where(last_stage, loss_sum, 0.0)
+    return loss_sum, valid.sum()
 
 
 # ---------------------------------------------------------------------------
@@ -415,21 +461,35 @@ def _pipeline_loss_local(
     # shift is a collective, kept off the per-tick path)
     targets_m = mb_view(_sp_shift_labels(batch["labels"], sp_size))
 
-    def mb_loss(h, targets):
+    def mb_loss(h, targets, take):
         """Per-microbatch loss from last-stage hiddens. Checkpointed in the
         tick so the [mb, L, vocab] logits are recomputed in backward from the
-        (already stored) hiddens — never M copies of logits."""
-        h = llama.final_norm(params, h, cfg)
-        if tp_size > 1:
-            return _vocab_parallel_token_loss(params, h, targets, cfg,
-                                              preshifted=True)
-        if pcfg.loss_chunks > 1:
-            from llama_pipeline_parallel_tpu.ops.cross_entropy import fused_ce_sum_count
+        (already stored) hiddens — never M copies of logits.
 
-            return fused_ce_sum_count(h, params["lm_head"].astype(cfg.dtype),
-                                      targets, pcfg.loss_chunks)
-        logits = llama.lm_head(params, h, cfg)
-        return llama.token_loss_sum_and_count_preshifted(logits, targets)
+        `take` (scalar bool: last stage AND a live microbatch) cond-gates the
+        head so only the owning stage's live ticks pay final-norm + lm-head +
+        CE. At tp=1 the whole head is collective-free and sits in the branch;
+        at tp>1 the gating happens inside _vocab_parallel_token_loss so the
+        tp collectives stay stage-uniform."""
+        if tp_size > 1:
+            hn = llama.final_norm(params, h, cfg)
+            return _vocab_parallel_token_loss(params, hn, targets, cfg,
+                                              preshifted=True, last_stage=take)
+
+        def head(h_, targets_):
+            hn = llama.final_norm(params, h_, cfg)
+            if pcfg.loss_chunks > 1:
+                from llama_pipeline_parallel_tpu.ops.cross_entropy import fused_ce_sum_count
+
+                return fused_ce_sum_count(hn, params["lm_head"].astype(cfg.dtype),
+                                          targets_, pcfg.loss_chunks)
+            logits = llama.lm_head(params, hn, cfg)
+            return llama.token_loss_sum_and_count_preshifted(logits, targets_)
+
+        return jax.lax.cond(
+            take, head,
+            lambda h_, targets_: (jnp.float32(0.0), jnp.int32(0)),
+            h, targets)
 
     mb_loss = jax.checkpoint(mb_loss)
 
@@ -466,11 +526,11 @@ def _pipeline_loss_local(
                                                     sp_size, k_max))
 
         # The last stage's finished microbatch contributes its loss in-tick
-        # (nothing is collected into an M-sized buffer; lm-head cost per tick
-        # is a few percent of a stage's decoder layers at real sizes).
+        # (nothing is collected into an M-sized buffer; the head itself is
+        # cond-gated inside mb_loss so only the owning stage pays it).
         targets = jax.lax.dynamic_index_in_dim(targets_m, mb_idx, keepdims=False)
-        mb_sum, mb_count = mb_loss(y, targets)
         take = is_last & (my_idx >= 0)
+        mb_sum, mb_count = mb_loss(y, targets, take)
         loss_sum = loss_sum + jnp.where(take, mb_sum, 0.0)
         count = count + jnp.where(take, mb_count, 0)
 
@@ -528,11 +588,13 @@ def _pipeline_1f1b_local(
 
     Embed and the loss head run under `lax.cond` on the stage index: only
     stage 0 pays the embedding gather (and its backward scatter into [V, d]),
-    only the last stage pays final-norm + lm-head + CE. The cond branches
+    only the last stage pays the lm-head matmul + CE — and only on its LIVE
+    backward ticks (loss_gate), not the warmup/drain ones. The cond branches
     must stay COLLECTIVE-FREE — a collective executed by only some devices
     aborts/deadlocks the runtime — so the sp label shift is hoisted out to
-    batch level, and the tp>1 vocab-parallel head (tp psums inside) falls
-    back to where-masked computation on every stage instead of cond.
+    batch level, and under tp>1 the vocab-parallel CE keeps its tp
+    collectives outside the cond with the heavy matmul/statistics gated
+    inside it (_vocab_parallel_token_loss's `last_stage` mode).
     """
     s_total = pcfg.num_stages
     m_total = pcfg.num_microbatches
@@ -576,11 +638,20 @@ def _pipeline_1f1b_local(
         cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta, dtype=cfg.dtype)
         return my_ids, pad, cos, sin, targets
 
-    def stage_fwd(p, x_in, my_ids, pad, cos, sin, targets, with_loss):
+    def stage_fwd(p, x_in, my_ids, pad, cos, sin, targets, with_loss,
+                  loss_gate=None):
         """`targets` are next-token labels already aligned with this slab
         (the sp cross-shard shift happens at TICK level, outside any cond —
         a collective must never sit inside a stage-divergent branch: only
         some devices would execute it, which deadlocks/aborts the runtime).
+
+        `loss_gate`: scalar bool (the schedule's b_valid) — warmup/drain
+        ticks whose loss would be masked anyway skip the head compute
+        entirely. NOT stage-uniform (b_valid depends on the stage index); it
+        is only uniform WITHIN one tp group, so it may gate the tp-local
+        head work but must never gate a collective — not even a tp one,
+        since keeping all collectives unconditional is what makes their
+        uniformity hold by construction.
         """
         x0 = jax.lax.cond(
             is_first,
@@ -597,27 +668,30 @@ def _pipeline_1f1b_local(
         if not with_loss:
             return y
 
-        def head_branch(norm_w, head_w, y_):
-            h = llama.final_norm({"norm": norm_w}, y_, cfg)
-            if tp_size > 1:
-                return _vocab_parallel_token_loss({"lm_head": head_w}, h,
-                                                  targets, cfg, preshifted=True)[0]
-            if pcfg.loss_chunks > 1:
-                from llama_pipeline_parallel_tpu.ops.cross_entropy import fused_ce_sum_count
-
-                return fused_ce_sum_count(h, head_w.astype(cfg.dtype), targets,
-                                          pcfg.loss_chunks)[0]
-            logits = llama.lm_head({"lm_head": head_w}, h, cfg)
-            return llama.token_loss_sum_and_count_preshifted(logits, targets)[0]
-
+        gate = is_last if loss_gate is None else is_last & loss_gate
         if tp_size > 1:
-            # The vocab-parallel CE contains tp collectives, so it cannot be
-            # cond-gated onto the last stage (see docstring) — compute it
-            # masked on every stage instead, as the gpipe schedule does.
-            mb_sum = jnp.where(is_last, head_branch(p["norm"], p["lm_head"], y), 0.0)
+            # The vocab-parallel CE's tp collectives run stage-uniformly; the
+            # heavy matmul + CE stats inside it are cond-gated to `gate`
+            # (see _vocab_parallel_token_loss). final_norm stays unmasked —
+            # elementwise [mb, L, d], negligible — because tp_copy must sit
+            # between it and the matmul for complete norm grads.
+            h = llama.final_norm({"norm": p["norm"]}, y, cfg)
+            mb_sum = _vocab_parallel_token_loss(
+                {"lm_head": p["lm_head"]}, h, targets, cfg,
+                preshifted=True, last_stage=gate)[0]
         else:
+            def head_branch(norm_w, head_w, y_):
+                h = llama.final_norm({"norm": norm_w}, y_, cfg)
+                if pcfg.loss_chunks > 1:
+                    from llama_pipeline_parallel_tpu.ops.cross_entropy import fused_ce_sum_count
+
+                    return fused_ce_sum_count(h, head_w.astype(cfg.dtype),
+                                              targets, pcfg.loss_chunks)[0]
+                logits = llama.lm_head({"lm_head": head_w}, h, cfg)
+                return llama.token_loss_sum_and_count_preshifted(logits, targets)[0]
+
             mb_sum = jax.lax.cond(
-                is_last, head_branch, lambda norm_w, head_w, y_: jnp.float32(0.0),
+                gate, head_branch, lambda norm_w, head_w, y_: jnp.float32(0.0),
                 p["norm"], p["lm_head"], y)
         return y, mb_sum
 
@@ -659,7 +733,7 @@ def _pipeline_1f1b_local(
 
         def h(p, x_in):
             return stage_fwd(p, x_in, ids_b, pad_b, cos_b, sin_b, targets_b,
-                             with_loss=True)
+                             with_loss=True, loss_gate=b_valid)
 
         (_, mb_sum), pullback = jax.vjp(h, params, x_in_b)
         # vjp is linear in the cotangent, so masked-out ticks (zero seeds)
