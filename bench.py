@@ -13,6 +13,19 @@ configuration as the headline, with every config's number in the detail
 field. The reference publishes no throughput numbers (BASELINE.md), so
 vs_baseline is measured MFU / 0.45 — the 45%-MFU north-star from
 BASELINE.json.
+
+After the headline sweep, three NON-headline rows bench the paths the 65B
+run of record actually uses (they appear under `all_configs` prefixed
+`extra:` but never win the headline — their tokens/s are not
+shape-comparable):
+- `extra:offload` — the SAME step with the host-offloaded AdamW
+  (optim/offload.py) instead of the fused optax update; its delta vs the
+  matching fused row is the measured offload stall, and the row carries the
+  d2h/update/h2d phase breakdown from `host.last_timings`.
+- `extra:packed` — a FLAN-shaped packed batch (segment-id masks, ~real
+  workload); its tokens/s counts REAL (non-pad) tokens only, the
+  `real_tokens_per_sec` headline of packed training.
+- `extra:seq2048-flash` — the long-context shape on the flash kernel.
 """
 
 from __future__ import annotations
@@ -59,11 +72,15 @@ def main() -> None:
     summary_ctx: dict = {}
 
     def report():
-        if not results or not summary_ctx:
+        # extras (offload/packed/long-seq rows) are excluded from the
+        # headline: their tokens/s are not shape-comparable with the sweep
+        headliners = {k: r for k, r in results.items()
+                      if r.get("headline", True)}
+        if not headliners or not summary_ctx:
             return None
         tps_of = lambda r: r["tokens_per_step"] / r["dt"]
-        best_name = max(results, key=lambda k: tps_of(results[k]))
-        best = results[best_name]
+        best_name = max(headliners, key=lambda k: tps_of(headliners[k]))
+        best = headliners[best_name]
         tps = tps_of(best)
         mfu = summary_ctx["flops_token"] * tps / summary_ctx["peak"]
         return {
@@ -75,7 +92,8 @@ def main() -> None:
             "step_time_ms": round(1000 * best["dt"], 1),
             "best_config": best_name,
             "all_configs": {k: {"ms": round(1000 * r["dt"], 1),
-                                "tok_s": round(tps_of(r), 1)}
+                                "tok_s": round(tps_of(r), 1),
+                                **r.get("detail", {})}
                             for k, r in results.items()},
             # round-1 emitted a flat name->ms map under this key; keep it so
             # round-over-round consumers keep parsing (ADVICE round-3)
@@ -131,15 +149,46 @@ def main() -> None:
     tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-4, total_steps=1000,
                                                warmup_steps=10))
 
-    def make_batch(batch_size: int) -> dict:
-        ids = np.random.RandomState(0).randint(3, cfg.vocab_size,
-                                               (batch_size, seq)).astype(np.int32)
+    def make_batch(batch_size: int, seq_len: int | None = None,
+                   packed: bool = False) -> dict:
+        L = seq_len or seq
+        rs = np.random.RandomState(0)
+        ids = rs.randint(3, cfg.vocab_size, (batch_size, L)).astype(np.int32)
+        if not packed:
+            return {
+                "input_ids": jnp.asarray(ids),
+                "attention_mask": jnp.ones((batch_size, L), jnp.int32),
+                "position_ids": jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32),
+                                                 (batch_size, L)),
+                "labels": jnp.asarray(ids),
+            }
+        # FLAN-shaped packing: variable-length segments greedily packed per
+        # row (the packed collator's contract — attention_mask carries
+        # segment ids 1..k, 0 = pad; position_ids restart per segment;
+        # segment-start labels ignored). Mean segment ~L/4 so rows carry
+        # several segments plus a realistic pad tail.
+        from llama_pipeline_parallel_tpu.models.llama.model import (
+            IGNORE_INDEX as IGNORE,
+        )
+
+        mask = np.zeros((batch_size, L), np.int32)
+        pos = np.zeros((batch_size, L), np.int32)
+        labels = ids.astype(np.int32).copy()
+        for b in range(batch_size):
+            cursor, seg_id = 0, 1
+            while L - cursor >= max(8, L // 16):
+                length = min(int(rs.randint(L // 8, L // 2)), L - cursor)
+                mask[b, cursor:cursor + length] = seg_id
+                pos[b, cursor:cursor + length] = np.arange(length)
+                labels[b, cursor] = IGNORE
+                cursor += length
+                seg_id += 1
+            labels[b, cursor:] = IGNORE  # pad tail
         return {
             "input_ids": jnp.asarray(ids),
-            "attention_mask": jnp.ones((batch_size, seq), jnp.int32),
-            "position_ids": jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32),
-                                             (batch_size, seq)),
-            "labels": jnp.asarray(ids),
+            "attention_mask": jnp.asarray(mask),
+            "position_ids": jnp.asarray(pos),
+            "labels": jnp.asarray(labels),
         }
 
     peak = detect_chip_peak_flops() or 197e12
@@ -147,41 +196,70 @@ def main() -> None:
     summary_ctx.update(peak=peak, flops_token=flops_token,
                        model=f"{model_name} seq{seq} bf16 1f1b")
 
+    offload_phases: dict = {}  # host.last_timings of the latest offload row
+
     def measure(remat: bool, attn_name: str, batch_size: int,
-                trace_dir: str | None = None) -> float | None:
+                trace_dir: str | None = None, seq_len: int | None = None,
+                packed: bool = False, offload: bool = False) -> float | None:
         """Mean steady-state step seconds for one config; None if it fails
         (e.g. flash unsupported shape / OOM with remat off) or its loss is
         not finite (a fast-but-broken config must never win the headline).
         `trace_dir` captures a profiler trace of the timed loop only (the
-        warmup/compile step stays outside the trace)."""
+        warmup/compile step stays outside the trace). `offload` swaps the
+        fused optax update for the host-offloaded AdamW (the 65B path's
+        optimizer) and records its phase breakdown in `offload_phases`."""
         import math
 
         try:
-            batch = make_batch(batch_size)
+            batch = make_batch(batch_size, seq_len, packed)
             attn_fn = flash_attention if attn_name == "flash" else attention
             pcfg = pl.PipelineConfig(num_stages=1, num_microbatches=1, remat=remat)
-            state = ts.init_train_state(stacked, tx, mesh)
-            step = ts.make_train_step(mesh, cfg, pcfg, tx, sched, stacked,
-                                      attn_fn=attn_fn)
+            if offload:
+                from llama_pipeline_parallel_tpu.optim.offload import (
+                    HostOffloadAdamW,
+                )
+
+                host = HostOffloadAdamW(OptimizerConfig(
+                    learning_rate=1e-4, total_steps=1000, warmup_steps=0))
+                host.init(stacked)
+                grad_fn = jax.jit(pl.make_pipeline_loss_and_grad(
+                    mesh, cfg, pcfg, host.abstract_tree(), attn_fn=attn_fn))
+                dev_box = [host.device_params(cfg.dtype)]
+
+                def step_once():
+                    loss, grads = grad_fn(dev_box[0], batch)
+                    dev_box[0] = host.update_and_refresh(grads, cfg.dtype)
+                    return loss
+            else:
+                state_box = [ts.init_train_state(stacked, tx, mesh)]
+                step = ts.make_train_step(mesh, cfg, pcfg, tx, sched, stacked,
+                                          attn_fn=attn_fn)
+
+                def step_once():
+                    state_box[0], metrics = step(state_box[0], batch)
+                    return metrics["loss"]
+
             # warmup (compile) + steady-state timing. The loss VALUE is
             # fetched every step: on the axon remote platform
             # block_until_ready alone does not wait for the donated-state
             # dependency chain, so value-fetch is the only reliable execution
             # barrier (cost: one scalar D2H per step).
-            state, metrics = step(state, batch)
-            float(metrics["loss"])
+            float(step_once())
             if trace_dir:
                 jax.profiler.start_trace(trace_dir)
             try:
                 t0 = time.perf_counter()
                 last = 0.0
                 for _ in range(n_steps):
-                    state, metrics = step(state, batch)
-                    last = float(metrics["loss"])
+                    last = float(step_once())
                 dt = (time.perf_counter() - t0) / n_steps
             finally:
                 if trace_dir:  # finalize whatever was captured, even on error
                     jax.profiler.stop_trace()
+            if offload:
+                offload_phases.clear()
+                offload_phases.update({k: round(v, 2)
+                                       for k, v in host.last_timings.items()})
             if not math.isfinite(last):
                 print(f"bench config remat={remat} attn={attn_name} "
                       f"bs={batch_size} produced non-finite loss {last}; "
@@ -190,7 +268,8 @@ def main() -> None:
             return dt
         except Exception as e:
             print(f"bench config remat={remat} attn={attn_name} "
-                  f"bs={batch_size} failed: {e!r}", file=sys.stderr, flush=True)
+                  f"bs={batch_size} seq={seq_len or seq} packed={packed} "
+                  f"offload={offload} failed: {e!r}", file=sys.stderr, flush=True)
             return None
 
     # Likely-fastest first, so a mid-sweep wedge still reports a strong
@@ -209,6 +288,41 @@ def main() -> None:
         dt = measure(remat, attn_name, bs)
         if dt is not None:
             results[name] = {"dt": dt, "tokens_per_step": bs * seq}
+
+    # Non-headline rows: the paths the 65B run of record actually exercises
+    # (offloaded optimizer, packed FLAN-shaped batches, long-context flash).
+    # Run AFTER the sweep so a wedge here still reports the full headline;
+    # BENCH_EXTRAS=0 skips them.
+    if os.environ.get("BENCH_EXTRAS", "1") != "0":
+        bs_big = max(batches)
+        long_seq = 2048 if os.environ.get("BENCH_MODEL") != "tiny" else seq * 2
+
+        dt = measure(False, "exact", bs_big, offload=True)
+        if dt is not None:
+            fused = results.get(f"remat=0,attn=exact,bs={bs_big}")
+            detail = {"phases_ms": dict(offload_phases)}
+            if fused:  # measured offload stall vs the matching fused row
+                detail["stall_vs_fused_ms"] = round(1000 * (dt - fused["dt"]), 1)
+            results[f"extra:offload,bs={bs_big}"] = {
+                "dt": dt, "tokens_per_step": bs_big * seq,
+                "headline": False, "detail": detail}
+
+        packed_batch = make_batch(bs_big, packed=True)
+        real_tokens = int((np.asarray(packed_batch["attention_mask"]) != 0).sum())
+        dt = measure(False, "exact", bs_big, packed=True)
+        if dt is not None:
+            # tokens/s counts REAL (non-pad) tokens: the packed-training
+            # headline number (real_tokens_per_sec)
+            results[f"extra:packed,bs={bs_big}"] = {
+                "dt": dt, "tokens_per_step": real_tokens, "headline": False,
+                "detail": {"real_tokens_per_step": real_tokens,
+                           "padded_tokens_per_step": bs_big * seq}}
+
+        dt = measure(False, "flash", 8, seq_len=long_seq)
+        if dt is not None:
+            results[f"extra:seq{long_seq}-flash,bs=8"] = {
+                "dt": dt, "tokens_per_step": 8 * long_seq, "headline": False,
+                "detail": {"seq": long_seq}}
 
     summary = report()
     watchdog.cancel()
