@@ -189,6 +189,82 @@ def preflight(cfg: dict, hbm_gb: float) -> dict:
     return report
 
 
+def calibrate() -> dict:
+    """Compile the BENCH config's single-chip train step on BOTH backends —
+    the real TPU (via the axon platform) and XLA-CPU — and report both
+    `memory_analysis()` peaks side by side. This puts an error bar on every
+    XLA-CPU preflight verdict (the tool's own caveat: TPU layouts/padding and
+    Mosaic VMEM differ). Run it whenever a chip is reachable; record the
+    margin in docs/PREFLIGHT.md. AOT only — no arrays materialize, so it
+    needs the tunnel for compilation RPCs but never runs a step."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from __graft_entry__ import _bench_config  # repo root on sys.path (module top)
+
+    from llama_pipeline_parallel_tpu.models.llama import model as llama
+    from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+    from llama_pipeline_parallel_tpu.optim import OptimizerConfig, make_optimizer
+    from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+    from llama_pipeline_parallel_tpu.parallel import train_step as ts
+    from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg = _bench_config()
+    manifest = StageManifest.for_config(cfg, 1)
+    tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-4,
+                                               total_steps=1000, warmup_steps=10))
+    gib = 1 << 30
+    out: dict = {"model": "bench-550m", "batch": 8, "seq": 512}
+    # cpu FIRST: a wedged TPU tunnel hangs the tpu compile, and the caller's
+    # timeout should still have the cpu half on stdout by then
+    for backend in ("cpu", "tpu"):
+        try:
+            devices = jax.devices(backend)
+        except RuntimeError as e:
+            out[backend] = f"backend unavailable: {e}"
+            continue
+        mesh = make_mesh(MeshConfig(), devices=devices[:1])
+        stacked_abs = jax.eval_shape(
+            lambda rng: pl.stack_stages(llama.init_params(rng, cfg), manifest),
+            jax.random.PRNGKey(0))
+        shardings = ts.state_shardings(mesh, tx, stacked_abs)
+        opt_abs = jax.eval_shape(tx.init, stacked_abs)
+
+        def annotate(tree_abs, tree_shard):
+            return jax.tree.map(
+                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+                tree_abs, tree_shard)
+
+        state_abs = ts.TrainState(
+            step=jax.ShapeDtypeStruct((), jnp.int32, sharding=shardings.step),
+            params=annotate(stacked_abs, shardings.params),
+            opt_state=annotate(opt_abs, shardings.opt_state))
+        b_spec = NamedSharding(mesh, pl.batch_specs(mesh)["input_ids"])
+        batch_abs = {k: jax.ShapeDtypeStruct((8, 512), jnp.int32, sharding=b_spec)
+                     for k in ("input_ids", "attention_mask", "position_ids",
+                               "labels")}
+        pcfg = pl.PipelineConfig(num_stages=1, num_microbatches=1, remat=False)
+        step = ts.make_train_step(mesh, cfg, pcfg, tx, sched, stacked_abs)
+        ma = step.lower(state_abs, batch_abs).compile().memory_analysis()
+        if ma is None:
+            out[backend] = "no memory analysis exposed"
+            continue
+        arg = getattr(ma, "argument_size_in_bytes", 0)
+        o = getattr(ma, "output_size_in_bytes", 0)
+        temp = getattr(ma, "temp_size_in_bytes", 0)
+        alias = getattr(ma, "alias_size_in_bytes", 0)
+        out[backend] = {"arguments_gib": round(arg / gib, 3),
+                        "outputs_gib": round(o / gib, 3),
+                        "temp_gib": round(temp / gib, 3),
+                        "peak_gib": round((arg + o + temp - alias) / gib, 3)}
+        print(f"calibrate[{backend}]: {out[backend]}", flush=True)
+    if isinstance(out.get("tpu"), dict) and isinstance(out.get("cpu"), dict):
+        cpu_peak, tpu_peak = out["cpu"]["peak_gib"], out["tpu"]["peak_gib"]
+        out["tpu_over_cpu"] = round(tpu_peak / cpu_peak, 3) if cpu_peak else None
+    return out
+
+
 def _run_all(patterns: list[str], hbm_gb: float, overrides: list[str]) -> None:
     """Preflight every config matching `patterns` in its own subprocess (each
     needs a different virtual device count, fixed at jax import) and print a
@@ -237,6 +313,11 @@ def main(argv: list[str] | None = None) -> None:
                         "work too), one subprocess each (XLA device counts "
                         "differ per config), and print a summary table; "
                         "exit 1 if any fails")
+    p.add_argument("--calibrate", action="store_true",
+                   help="compile the bench config on BOTH the real TPU and "
+                        "XLA-CPU and print both memory_analysis() peaks — "
+                        "the error bar for every CPU-estimate verdict "
+                        "(needs the TPU tunnel; AOT only, runs nothing)")
     p.add_argument("overrides", nargs="*", help="key=value config overrides")
     args, unknown = p.parse_known_args(argv)
     bad = [u for u in unknown if not (u.startswith("--") and "=" in u)]
@@ -244,6 +325,11 @@ def main(argv: list[str] | None = None) -> None:
         p.error(f"unrecognized arguments: {' '.join(bad)}")
     args.overrides += unknown
 
+    if args.calibrate:
+        import json
+
+        print(json.dumps(calibrate(), indent=2))
+        return
     if args.all_globs is not None:
         if args.config:
             p.error("--config and --all are mutually exclusive")
