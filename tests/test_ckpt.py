@@ -49,6 +49,7 @@ def _trained_state(cfg, pp, dp, steps=2):
     return state, manifest, tx
 
 
+@pytest.mark.slow
 def test_full_roundtrip_same_topology(tmp_path, cfg, devices):
     state, manifest, tx = _trained_state(cfg, pp=2, dp=2)
     mgr = CheckpointManager(str(tmp_path))
@@ -60,6 +61,7 @@ def test_full_roundtrip_same_topology(tmp_path, cfg, devices):
     tree_equal(opt2, state.opt_state)
 
 
+@pytest.mark.slow
 def test_async_save_finalize_and_roundtrip(tmp_path, cfg, devices):
     """blocking=False: commit (meta/tag/on_complete) lands after finalize();
     back-to-back async saves serialize; the result round-trips bit-exactly."""
@@ -125,6 +127,7 @@ def test_async_save_surfaces_commit_failure(tmp_path, cfg, devices):
     mgr.finalize()  # error is consumed; manager stays usable
 
 
+@pytest.mark.slow
 def test_topology_change_restore(tmp_path, cfg, devices):
     """Save at PP=2, restore at PP=4 — forbidden by the reference's filename
     arithmetic, enabled by the canonical layout + manifest design."""
@@ -144,6 +147,7 @@ def test_topology_change_restore(tmp_path, cfg, devices):
     assert np.asarray(params4["layers"]["attn"]["wq"]).shape[:2] == (4, 1)
 
 
+@pytest.mark.slow
 def test_module_only_warm_start_from_full_ckpt(tmp_path, cfg, devices):
     state, manifest, tx = _trained_state(cfg, pp=2, dp=2)
     mgr = CheckpointManager(str(tmp_path))
@@ -152,6 +156,7 @@ def test_module_only_warm_start_from_full_ckpt(tmp_path, cfg, devices):
     tree_equal(params, state.params)
 
 
+@pytest.mark.slow
 def test_params_only_ckpt_refuses_full_resume(tmp_path, cfg, devices):
     state, manifest, tx = _trained_state(cfg, pp=2, dp=1, steps=1)
     mgr = CheckpointManager(str(tmp_path))
@@ -163,6 +168,7 @@ def test_params_only_ckpt_refuses_full_resume(tmp_path, cfg, devices):
     tree_equal(params, state.params)
 
 
+@pytest.mark.slow
 def test_latest_tag_and_resume_detection(tmp_path, cfg, devices):
     assert find_resume_checkpoint(str(tmp_path / "nope")) is None
     state, manifest, tx = _trained_state(cfg, pp=2, dp=1, steps=1)
@@ -177,6 +183,7 @@ def test_latest_tag_and_resume_detection(tmp_path, cfg, devices):
     assert find_resume_checkpoint(str(tmp_path))[0] == 5
 
 
+@pytest.mark.slow
 def test_hf_export_round_trip(tmp_path, cfg, devices):
     """native ckpt -> HF (tools/export_hf) -> logits parity with our forward."""
     torch = pytest.importorskip("torch")

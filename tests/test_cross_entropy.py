@@ -66,6 +66,7 @@ def test_indivisible_vocab_rejected():
 
 
 @pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+@pytest.mark.slow
 def test_pipeline_loss_chunks_parity(devices, schedule):
     """loss AND grads identical with/without the fused loss head at PP=2."""
     from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig

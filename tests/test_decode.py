@@ -42,6 +42,7 @@ def greedy_no_cache(params, cfg, ids, mask, n_new):
     return np.stack(out, axis=1)  # [b, n_new]
 
 
+@pytest.mark.slow
 def test_greedy_matches_cache_free_forward(setup):
     cfg, params = setup
     rng = np.random.RandomState(0)

@@ -17,6 +17,9 @@ import sys
 import numpy as np
 import pytest
 
+# multi-process spawns: the expensive lane (round gate); `-m 'not slow'` skips
+pytestmark = pytest.mark.slow
+
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "mp_worker.py")
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

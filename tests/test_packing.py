@@ -313,6 +313,7 @@ def _packed_cfg(tmp_path, tokenizer_dir, out: str, **kw) -> dict:
     return cfg
 
 
+@pytest.mark.slow
 def test_packed_training_end_to_end(devices, tmp_path, tokenizer_dir):
     """run_training with packing_factor=2 over a real jsonl dataset and
     tokenizer: packed rows flow through the PP=2 pipeline, loss is finite,
@@ -331,6 +332,7 @@ def test_packed_training_end_to_end(devices, tmp_path, tokenizer_dir):
         assert 0.0 <= line["packing_drop_rate"] <= 1.0
 
 
+@pytest.mark.slow
 def test_packed_ulysses_sp_matches_sp1(devices, tmp_path, tokenizer_dir):
     """Packing composes with Ulysses sequence parallelism (the mask is
     all-gathered to full length, so segment pairing stays exact): the sp=2
@@ -346,6 +348,7 @@ def test_packed_ulysses_sp_matches_sp1(devices, tmp_path, tokenizer_dir):
                                rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_packed_ring_sp_matches_sp1(devices, tmp_path, tokenizer_dir):
     """Packing composes with RING sequence parallelism: pcfg.packed switches
     on the rotating kv segment slab (parallel/ring_attention.py), so the

@@ -79,7 +79,10 @@ def test_pp1_matches_plain_forward(cfg, params, devices):
     assert_tree_close(grads, ref_grads)
 
 
-@pytest.mark.parametrize("pp,dp,microbatches", [(4, 1, 4), (4, 1, 6), (2, 2, 3), (4, 2, 4)])
+@pytest.mark.parametrize("pp,dp,microbatches", [
+    (4, 1, 4), (2, 2, 3),
+    pytest.param(4, 1, 6, marks=pytest.mark.slow),
+    pytest.param(4, 2, 4, marks=pytest.mark.slow)])
 def test_pp_matches_reference(cfg, params, devices, pp, dp, microbatches):
     """PP=N hybrid grids reproduce the single-device loss AND gradients."""
     batch = make_batch(cfg, batch_size=dp * microbatches * 2)
@@ -111,7 +114,10 @@ def test_gpipe_schedule_matches(cfg, params, devices):
     assert_tree_close(grads, ref_grads)
 
 
-@pytest.mark.parametrize("pp,microbatches", [(4, 2), (4, 4), (2, 1)])
+@pytest.mark.parametrize("pp,microbatches", [
+    (2, 1),
+    pytest.param(4, 2, marks=pytest.mark.slow),
+    pytest.param(4, 4, marks=pytest.mark.slow)])
 def test_1f1b_fewer_microbatches_than_stages(cfg, params, devices, pp, microbatches):
     """1F1B edge cases M < S, M == S, M == 1: the warmup/drain masking and the
     min(2S-1, M) input ring buffer must stay exact when the pipe never fills."""
@@ -141,6 +147,7 @@ def test_remat_off_matches(cfg, params, devices):
     assert_tree_close(g1, g2)
 
 
+@pytest.mark.slow
 def test_pp8_headline_topology(devices):
     """The 65B config-of-record topology (PP=8, chunked accumulation) at tiny
     scale on the full 8-device mesh — every stage boundary exercised."""
@@ -154,6 +161,7 @@ def test_pp8_headline_topology(devices):
     assert_tree_close(grads, ref_grads)
 
 
+@pytest.mark.slow
 def test_1f1b_memory_bounded_in_microbatches(cfg, params, devices):
     """THE point of 1F1B (VERDICT round-1 item 3's acceptance criterion):
     in-flight activation memory must not grow with the grad-accumulation

@@ -8,6 +8,9 @@ import time
 
 import pytest
 
+# multi-process spawns: the expensive lane (round gate); `-m 'not slow'` skips
+pytestmark = pytest.mark.slow
+
 
 def test_sigterm_saves_checkpoint(tmp_path):
     out_dir = tmp_path / "out"

@@ -3,6 +3,11 @@
 subprocess because the tool must pin XLA_FLAGS before jax's first import."""
 
 import json
+
+import pytest
+
+# each case AOT-compiles a big config in a subprocess
+pytestmark = pytest.mark.slow
 import os
 import subprocess
 import sys

@@ -52,10 +52,10 @@ def run_sp_pipeline(params, batch, cfg, pp, dp, sp, microbatches,
 
 @pytest.mark.parametrize("pp,dp,sp,strategy", [
     (1, 1, 4, "ring"),
-    (2, 1, 2, "ring"),
     (2, 2, 2, "ring"),
     (1, 1, 2, "ulysses"),
-    (2, 1, 2, "ulysses"),
+    pytest.param(2, 1, 2, "ring", marks=pytest.mark.slow),
+    pytest.param(2, 1, 2, "ulysses", marks=pytest.mark.slow),
 ])
 def test_sp_in_pipeline_matches_reference(cfg, params, devices, pp, dp, sp, strategy):
     """PP x SP x DP grids, both strategies: exact loss and gradient parity.

@@ -36,8 +36,10 @@ def run_tp(params, batch, cfg, pp, dp, tp, microbatches):
     return loss, pl.unstack_stages(grads, manifest)
 
 
-@pytest.mark.parametrize("pp,dp,tp,mb", [(1, 1, 2, 2), (2, 1, 2, 2),
-                                         (2, 2, 2, 2), (1, 1, 4, 2)])
+@pytest.mark.parametrize("pp,dp,tp,mb", [
+    (1, 1, 2, 2), (1, 1, 4, 2),
+    pytest.param(2, 1, 2, 2, marks=pytest.mark.slow),
+    pytest.param(2, 2, 2, 2, marks=pytest.mark.slow)])
 def test_tp_matches_reference(cfg, params, devices, pp, dp, tp, mb):
     if tp == 4 and cfg.kv_heads % 4:
         pytest.skip("tp=4 needs kv_heads % 4 == 0")

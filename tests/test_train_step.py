@@ -76,6 +76,7 @@ def test_zero1_opt_state_is_dp_sharded(devices):
     assert "dp" not in [s for s in jax.tree.leaves(tuple(pspec))]
 
 
+@pytest.mark.slow
 def test_train_step_matches_across_topologies(devices):
     """Same data, same init: PP=4xDP=2 and PP=1xDP=1 produce the same params
     after a step (the hybrid-grid determinism the reference could never test)."""

@@ -40,6 +40,7 @@ def test_smoke_run_writes_metrics_and_ckpt(tmp_path, devices):
     assert os.path.exists(os.path.join(out, "training_config.json"))
 
 
+@pytest.mark.slow
 def test_schedule_knob_equivalence(tmp_path, devices):
     """pipeline_schedule: gpipe (+ chunks) through the FULL trainer produces
     the same losses as the default 1f1b — the knob is plumbed end to end and
@@ -51,6 +52,7 @@ def test_schedule_knob_equivalence(tmp_path, devices):
     np.testing.assert_allclose(gp["final_loss"], ref["final_loss"], rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_resume_continues_identically(tmp_path, devices):
     """Interrupted-at-4 + resume-to-8 must equal straight-through-to-8
     (the reference's resume fast-forward contract, trainer_base_ds_mp:345-351)."""
@@ -66,6 +68,7 @@ def test_resume_continues_identically(tmp_path, devices):
     np.testing.assert_allclose(resumed["final_loss"], straight["final_loss"], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_async_save_loop_durable_and_resumable(tmp_path, devices):
     """async_save: periodic checkpoints commit in the background but are
     durable by loop exit, and a resumed run picks the latest one up."""
@@ -90,6 +93,7 @@ def test_warm_start_requires_checkpoint(tmp_path, devices):
         run_training(cfg)
 
 
+@pytest.mark.slow
 def test_offload_loop_runs_and_resumes(tmp_path, devices):
     """Host-offloaded optimizer path: loss decreases on a fixed-seed synthetic
     set; interrupted + resumed equals straight-through."""
@@ -101,6 +105,7 @@ def test_offload_loop_runs_and_resumes(tmp_path, devices):
     np.testing.assert_allclose(resumed["final_loss"], straight["final_loss"], rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_offload_zero2_matches_plain_offload(tmp_path, devices):
     """optimizer_offload_zero2 (dp-sharded masters/moments + reduce-scattered
     grads + per-step dp re-gather of the bf16 working copy) is numerically
@@ -115,6 +120,7 @@ def test_offload_zero2_matches_plain_offload(tmp_path, devices):
                                rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_offload_zero2_resumes_identically(tmp_path, devices):
     """z2 interrupted-at-2 + resume-to-4 equals straight z2: the dp-sharded
     master/moment templates round-trip through the checkpoint (the canonical
@@ -130,6 +136,7 @@ def test_offload_zero2_resumes_identically(tmp_path, devices):
                                rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_offload_zero2_uneven_partition_resumes(tmp_path, devices):
     """z2 composed with an uneven stage partition (5 layers on pp=2): the
     abstract unstack now carries trailing-dim (dp) shardings through the
@@ -191,6 +198,7 @@ def test_offload_save_total_limit(tmp_path, devices):
     assert mgr.latest_step() == 4
 
 
+@pytest.mark.slow
 def test_offload_with_uneven_stages(tmp_path, devices):
     """Host-offloaded optimizer composed with an auto-balanced uneven
     partition (5 layers on pp=2): the padded stacked layout must survive the
@@ -207,6 +215,7 @@ def test_offload_with_uneven_stages(tmp_path, devices):
     np.testing.assert_allclose(off["final_loss"], fused["final_loss"], rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_eval_loop(tmp_path, devices):
     cfg = base_cfg(tmp_path, eval_steps=2,
                    eval_dataset={"synthetic": True, "seq_length": 16,

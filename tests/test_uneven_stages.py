@@ -39,6 +39,7 @@ def run_uneven(params, batch, cfg, pp, counts, microbatches=4, schedule="1f1b",
     return loss, pl.unstack_stages(grads, manifest), manifest
 
 
+@pytest.mark.slow
 def test_13_layers_on_4_stages_matches_single_device(devices):
     """The VERDICT acceptance case: 13 layers, 4 stages, grad parity."""
     cfg = LlamaConfig.tiny(num_hidden_layers=13)
@@ -51,6 +52,7 @@ def test_13_layers_on_4_stages_matches_single_device(devices):
 
 
 @pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+@pytest.mark.slow
 def test_uneven_both_schedules(devices, schedule):
     cfg = LlamaConfig.tiny(num_hidden_layers=6)
     params = llama.init_params(jax.random.PRNGKey(1), cfg)
@@ -62,6 +64,7 @@ def test_uneven_both_schedules(devices, schedule):
     assert_tree_close(grads, ref_grads)
 
 
+@pytest.mark.slow
 def test_uneven_with_tp_identity_padding(devices):
     """tp>1 forbids cond-skipping, so the padded slots COMPUTE — the all-zero
     layer must still behave as an exact identity under tp collectives."""
@@ -92,6 +95,7 @@ def test_padded_slot_grads_are_zero(devices):
         np.testing.assert_array_equal(np.asarray(leaf)[1, 1], 0.0)
 
 
+@pytest.mark.slow
 def test_ckpt_restore_across_partition_change(devices, tmp_path):
     """Save under an uneven PP=4 partition, restore into an even PP=2 one:
     the canonical checkpoint layout is partition-agnostic (the reference's
