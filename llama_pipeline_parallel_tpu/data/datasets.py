@@ -161,6 +161,14 @@ class MixtureDataset:
     general mechanism: items are drawn from each source in proportion to
     `weights`, in a fixed interleave so every epoch sees the same order
     (shuffling happens in the sampler, by index).
+
+    Tail truncation: the epoch ends when the source that exhausts first has
+    yielded its last full block, so trailing examples of the OTHER sources
+    are silently dropped that epoch — up to `len(d) - blocks * per_block[j]`
+    per source (worst case just under one block per source). Extreme weight
+    ratios make blocks long and the truncation correspondingly coarser;
+    `len(self)` already reflects the truncated length, so samplers and
+    schedule-total computation stay exact.
     """
 
     datasets: Sequence[Any]

@@ -39,6 +39,16 @@ def expand_special_tokenizer(tokenizer: Any) -> int:
     """Ensure bos/eos/unk/pad exist; returns how many NEW tokens were added
     (callers must resize embeddings by that amount, reference
     convert2ckpt.py:60-63)."""
+    if is_seq2seq_tokenizer(tokenizer):
+        # Recorded strike (docs/PARITY.md): the reference's seq2seq collation
+        # branch (data/flan.py:152-157) is deliberately not ported — this
+        # framework trains dense causal LLaMA-family models only. Fail loudly
+        # here rather than silently training a causal LM on encoder text.
+        raise ValueError(
+            f"encoder-decoder tokenizer {tokenizer_get_name(tokenizer)!r}: "
+            "this framework trains dense causal LLaMA-family models only; "
+            "the reference's seq2seq branch is a recorded strike "
+            "(docs/PARITY.md)")
     special: dict[str, str] = {}
 
     # Fill in ONLY missing tokens — a tokenizer shipping nonstandard specials

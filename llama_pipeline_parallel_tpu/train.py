@@ -330,6 +330,7 @@ def select_attention(impl: str, seq_length: int, mesh,
 _STOP_SIGNALS: list[int] = []
 _INSTALLED_SIGNALS: list[int] = []
 _PREVIOUS_HANDLERS: dict = {}
+_NOTIFIER_PROBE_FAILED = False  # warn-once latch, see _cpp_notifier_owns_sigterm
 
 
 def _in_main_thread() -> bool:
@@ -360,10 +361,24 @@ def _cpp_notifier_owns_sigterm() -> bool:
     The notifier is registered with the preemption SYNC MANAGER, not the
     bare distributed client: `jax.distributed.initialize()` skips it when
     `jax_enable_preemption_service=False`, and then Python must keep owning
-    SIGTERM even though a client is active."""
-    from jax._src import distributed as jax_distributed
+    SIGTERM even though a client is active.
 
-    return jax_distributed.global_state.preemption_sync_manager is not None
+    Reads a jax internal and is called from inside signal handlers, so it
+    must never raise: if a JAX upgrade moves the attribute, fall back to
+    False (= Python keeps SIGTERM — the pre-init behavior) and warn once."""
+    try:
+        from jax._src import distributed as jax_distributed
+
+        return jax_distributed.global_state.preemption_sync_manager is not None
+    except (ImportError, AttributeError):  # jax internal moved
+        global _NOTIFIER_PROBE_FAILED
+        if not _NOTIFIER_PROBE_FAILED:
+            _NOTIFIER_PROBE_FAILED = True
+            logger.warning(
+                "jax._src.distributed.global_state.preemption_sync_manager "
+                "not found (jax internals changed); assuming Python owns "
+                "SIGTERM — pod preemption now relies on the Python handlers")
+        return False
 
 
 def _install_preemption_handlers() -> None:
@@ -730,7 +745,13 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
             # own cadence.
             preempt_notice = _preemption_notice(step)
             check_now = jax.process_count() == 1 or step % check_every == 0
-            if preempt_notice or (check_now and _should_stop(bool(_STOP_SIGNALS))):
+            # Both stop inputs are evaluated into locals BEFORE combining:
+            # _should_stop's allgather is a collective, so its call count must
+            # be identical on every process every step. Short-circuiting it
+            # behind preempt_notice would only be safe because the sync point
+            # fires process-uniformly — keep the uniformity structural.
+            stop_vote = check_now and _should_stop(bool(_STOP_SIGNALS))
+            if preempt_notice or stop_vote:
                 logger.warning("preemption signal; checkpointing at step %d and "
                                "exiting for clean resume", step)
                 preempted_at = step

@@ -126,7 +126,15 @@ class MetricsWriter:
 
     def __init__(self, output_dir: str, config_snapshot: dict | None = None,
                  use_wandb: bool = False, use_tensorboard: bool = False,
-                 project: str = "llama-pipeline-tpu"):
+                 project: str = "llama-pipeline-tpu",
+                 summary_metrics: dict[str, str] | None = None):
+        # wandb summary direction per metric (reference
+        # trainer_base_ds_mp.py:447 `wandb.define_metric` driven by
+        # prediction_cfg's metric/measure pair, conf yaml:108-112): the run
+        # summary shows best-so-far, not last-logged. name -> "min"|"max".
+        if summary_metrics is None:
+            summary_metrics = {"loss": "min", "eval_loss": "min"}
+        self._summary_metrics = summary_metrics
         os.makedirs(output_dir, exist_ok=True)
         self._f = open(os.path.join(output_dir, "metrics.jsonl"), "a", buffering=1)
         self._wandb = None
@@ -143,6 +151,13 @@ class MetricsWriter:
                 self._wandb = wandb.init(project=project, config=config_snapshot)
             except Exception as e:  # wandb not installed / offline
                 logger.warning("wandb unavailable (%r); falling back to jsonl only", e)
+            if self._wandb is not None:
+                try:
+                    for name, direction in self._summary_metrics.items():
+                        wandb.define_metric(name, summary=direction)
+                except Exception as e:  # run stays live; only best-so-far lost
+                    logger.warning("wandb.define_metric failed (%r); summary "
+                                   "shows last value, not best", e)
         if use_tensorboard:
             try:
                 from torch.utils.tensorboard import SummaryWriter

@@ -207,3 +207,15 @@ def test_expand_special_tokenizer_fills_missing_only():
     assert n == 1 and t.eos_token == "</s>"
     assert t.bos_token == "<CUSTOM_BOS>"  # untouched
     assert t.pad_token == "</s>"  # pad -> eos fallback
+
+
+def test_expand_special_tokenizer_rejects_seq2seq():
+    """The seq2seq branch is a recorded strike (docs/PARITY.md): an
+    encoder-decoder tokenizer must fail loudly at normalization, not train
+    a causal LM on encoder-only text."""
+
+    class T5TokenizerFast:
+        bos_token = eos_token = unk_token = pad_token = "<x>"
+
+    with pytest.raises(ValueError, match="recorded strike"):
+        expand_special_tokenizer(T5TokenizerFast())
