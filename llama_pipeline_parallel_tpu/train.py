@@ -365,7 +365,10 @@ def _cpp_notifier_owns_sigterm() -> bool:
 
     Reads a jax internal and is called from inside signal handlers, so it
     must never raise: if a JAX upgrade moves the attribute, fall back to
-    False (= Python keeps SIGTERM — the pre-init behavior) and warn once."""
+    False (= Python keeps SIGTERM — the pre-init behavior) and warn once —
+    via os.write, not logging: the logging stack is not async-signal-safe
+    (a signal landing mid-emit would re-enter a buffered writer), the same
+    rule _on_preemption_signal follows."""
     try:
         from jax._src import distributed as jax_distributed
 
@@ -374,10 +377,10 @@ def _cpp_notifier_owns_sigterm() -> bool:
         global _NOTIFIER_PROBE_FAILED
         if not _NOTIFIER_PROBE_FAILED:
             _NOTIFIER_PROBE_FAILED = True
-            logger.warning(
-                "jax._src.distributed.global_state.preemption_sync_manager "
-                "not found (jax internals changed); assuming Python owns "
-                "SIGTERM — pod preemption now relies on the Python handlers")
+            os.write(2, b"WARNING: jax._src.distributed.global_state."
+                        b"preemption_sync_manager not found (jax internals "
+                        b"changed); assuming Python owns SIGTERM - pod "
+                        b"preemption now relies on the Python handlers\n")
         return False
 
 
