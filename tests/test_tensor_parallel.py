@@ -82,3 +82,57 @@ def test_tp_train_step_and_zero1(cfg, params, devices):
     assert tuple(wq_spec) == ("pp", None, None, "tp")
     mu_spec = state.opt_state[1][0].mu["layers"]["attn"]["wo"].sharding.spec
     assert "tp" in tuple(mu_spec) and "dp" in tuple(mu_spec)
+
+
+def test_tp_head_matmul_is_cond_gated(devices):
+    """Structural pin for the round-5 head gating: under tp>1 the [d, V/tp]
+    lm-head matmul (and its vjp transposes) must sit inside `lax.cond`
+    branches — only the last stage pays it — while the tp collectives stay
+    outside. Regression guard: an edit that hoists the matmul back to
+    unconditional where-masked compute re-introduces pp x redundant head
+    FLOPs per tick without failing any parity test."""
+    pp, tp, mb = 2, 2, 2
+    # vocab 320 -> v_local 160, a width no other dot in the model can take
+    # (the default 256 would make v_local collide with intermediate_size=128
+    # under alternative tp shardings) — the shape match stays unambiguous
+    cfg = LlamaConfig.tiny(vocab_size=320)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh(MeshConfig(pp=pp, tp=tp))
+    manifest = StageManifest.for_config(cfg, pp)
+    stacked = pl.stack_stages(params, manifest)
+    pcfg = pl.PipelineConfig(num_stages=pp, num_microbatches=mb)
+    fn = pl.make_pipeline_loss_and_grad(mesh, cfg, pcfg, stacked)
+    batch = make_batch(cfg, batch_size=mb, seqlen=16)
+    jaxpr = jax.make_jaxpr(fn)(stacked, batch)
+
+    v_local = cfg.vocab_size // tp
+
+    def sub_jaxprs(v):
+        from jax.extend.core import ClosedJaxpr, Jaxpr
+
+        if isinstance(v, ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, Jaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                yield from sub_jaxprs(x)
+
+    in_cond_dots, outside_dots = [], []
+
+    def walk(jxp, in_cond):
+        for eqn in jxp.eqns:
+            nested_in_cond = in_cond or eqn.primitive.name == "cond"
+            for val in eqn.params.values():
+                for sub in sub_jaxprs(val):
+                    walk(sub, nested_in_cond)
+            if eqn.primitive.name == "dot_general":
+                out_aval = eqn.outvars[0].aval
+                if out_aval.shape and out_aval.shape[-1] == v_local:
+                    (in_cond_dots if in_cond else outside_dots).append(eqn)
+
+    walk(jaxpr.jaxpr, False)
+    assert in_cond_dots, "expected the [d, V/tp] head matmul inside lax.cond"
+    assert not outside_dots, (
+        f"{len(outside_dots)} vocab-shard matmuls escaped the cond gating: "
+        f"{[str(e.outvars[0].aval) for e in outside_dots]}")
