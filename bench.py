@@ -8,7 +8,8 @@ JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Sweeps the configuration knobs a user would actually tune on one chip —
 remat on/off (HBM is plentiful at this size; recompute is pure overhead when
-memory allows) and exact vs flash attention — and reports the BEST measured
+memory allows), exact vs flash attention, and the vocab-chunked fused CE
+(which never materializes the fp32 [tokens, V] logits) — and reports the BEST measured
 configuration as the headline, with every config's number in the detail
 field. The reference publishes no throughput numbers (BASELINE.md), so
 vs_baseline is measured MFU / 0.45 — the 45%-MFU north-star from
@@ -103,7 +104,7 @@ def main() -> None:
         }
 
     # 900s is known to be within the driver's own patience (round-1 artifact
-    # recorded a 900s watchdog fire); on a live chip the 8-config sweep takes
+    # recorded a 900s watchdog fire); on a live chip the 9-config sweep takes
     # ~5-6 min, and a mid-sweep wedge reports the best completed config.
     watchdog = _watchdog(int(os.environ.get("BENCH_TIMEOUT_S", "900")), report)
     import jax
@@ -199,8 +200,9 @@ def main() -> None:
     offload_phases: dict = {}  # host.last_timings of the latest offload row
 
     def measure(remat: bool, attn_name: str, batch_size: int,
-                trace_dir: str | None = None, seq_len: int | None = None,
-                packed: bool = False, offload: bool = False) -> float | None:
+                loss_chunks: int = 1, trace_dir: str | None = None,
+                seq_len: int | None = None, packed: bool = False,
+                offload: bool = False) -> float | None:
         """Mean steady-state step seconds for one config; None if it fails
         (e.g. flash unsupported shape / OOM with remat off) or its loss is
         not finite (a fast-but-broken config must never win the headline).
@@ -213,7 +215,8 @@ def main() -> None:
         try:
             batch = make_batch(batch_size, seq_len, packed)
             attn_fn = flash_attention if attn_name == "flash" else attention
-            pcfg = pl.PipelineConfig(num_stages=1, num_microbatches=1, remat=remat)
+            pcfg = pl.PipelineConfig(num_stages=1, num_microbatches=1,
+                                     remat=remat, loss_chunks=loss_chunks)
             if offload:
                 from llama_pipeline_parallel_tpu.optim.offload import (
                     HostOffloadAdamW,
@@ -262,13 +265,15 @@ def main() -> None:
                                        for k, v in host.last_timings.items()})
             if not math.isfinite(last):
                 print(f"bench config remat={remat} attn={attn_name} "
-                      f"bs={batch_size} produced non-finite loss {last}; "
-                      f"excluded", file=sys.stderr, flush=True)
+                      f"bs={batch_size} ce_chunks={loss_chunks} produced "
+                      f"non-finite loss {last}; excluded",
+                      file=sys.stderr, flush=True)
                 return None
             return dt
         except Exception as e:
             print(f"bench config remat={remat} attn={attn_name} "
-                  f"bs={batch_size} seq={seq_len or seq} packed={packed} "
+                  f"bs={batch_size} ce_chunks={loss_chunks} "
+                  f"seq={seq_len or seq} packed={packed} "
                   f"offload={offload} failed: {e!r}", file=sys.stderr, flush=True)
             return None
 
@@ -280,12 +285,24 @@ def main() -> None:
     # come from batch-boosted occupancy): each extra config costs a full
     # XLA compile, and the sweep must finish inside the 900s watchdog.
     configs = {f"remat={int(remat)},attn={attn_name},bs={bs}":
-               (remat, attn_name, bs)
+               (remat, attn_name, bs, 1)
                for remat in (False, True) for attn_name in ("exact", "flash")
                for bs in batches
                if attn_name == "exact" or bs == max(batches)}
-    for name, (remat, attn_name, bs) in configs.items():
-        dt = measure(remat, attn_name, bs)
+    # The vocab-chunked fused CE at the largest batch: the PP=1 step's
+    # biggest single buffer is the fp32 [tokens, V] logits (2 GiB at bs32
+    # seq512 V32k); the online-logsumexp head never materializes it, so this
+    # row is the HBM-traffic winner candidate. One extra compile, placed
+    # right after the likely-best plain row so a mid-sweep wedge still
+    # compares the two.
+    bs_top = max(batches)
+    head = {f"remat=0,attn=exact,bs={bs_top}":
+            configs.pop(f"remat=0,attn=exact,bs={bs_top}"),
+            f"remat=0,attn=exact,bs={bs_top},ce=chunk8":
+            (False, "exact", bs_top, 8)}
+    configs = {**head, **configs}
+    for name, (remat, attn_name, bs, chunks) in configs.items():
+        dt = measure(remat, attn_name, bs, chunks)
         if dt is not None:
             results[name] = {"dt": dt, "tokens_per_step": bs * seq}
 
