@@ -20,9 +20,10 @@ run of record actually uses (they appear under `all_configs` prefixed
 `extra:` but never win the headline — their tokens/s are not
 shape-comparable):
 - `extra:offload` — the SAME step with the host-offloaded AdamW
-  (optim/offload.py) instead of the fused optax update; its delta vs the
-  matching fused row is the measured offload stall, and the row carries the
-  d2h/update/h2d phase breakdown from `host.last_timings`.
+  (optim/offload.py, the trainer-default device-norm streaming path)
+  instead of the fused optax update; its delta vs the matching fused row is
+  the measured offload stall, and the row carries the phase breakdown from
+  `host.last_timings` (norm_ms + the streamed d2h/update/h2d span).
 - `extra:packed` — a FLAN-shaped packed batch (segment-id masks, ~real
   workload); its tokens/s counts REAL (non-pad) tokens only, the
   `real_tokens_per_sec` headline of packed training.
@@ -223,7 +224,8 @@ def main() -> None:
                 )
 
                 host = HostOffloadAdamW(OptimizerConfig(
-                    learning_rate=1e-4, total_steps=1000, warmup_steps=0))
+                    learning_rate=1e-4, total_steps=1000, warmup_steps=0),
+                    device_norm=True)  # the trainer's default streaming path
                 host.init(stacked)
                 grad_fn = jax.jit(pl.make_pipeline_loss_and_grad(
                     mesh, cfg, pcfg, host.abstract_tree(), attn_fn=attn_fn))

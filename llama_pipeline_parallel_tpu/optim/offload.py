@@ -216,6 +216,17 @@ class HostOffloadAdamW:
     """
 
     cfg: OptimizerConfig
+    # Compute the global grad norm ON DEVICE (one fused XLA reduction + a
+    # scalar D2H) instead of on the host after the full-tree D2H. The host
+    # path must pull EVERY gradient byte down before the first AdamW can run
+    # (the global clip factor depends on all of them — the SURVEY §7.3-item-3
+    # serialization); with the scalar known up front, the fused step streams
+    # leaf-by-leaf — wait-for-leaf-i, update-i, cast-i, upload-i — so later
+    # leaves' wire time hides behind earlier leaves' host compute. Numerics:
+    # fp32 accumulation, exactly optax.clip_by_global_norm's math (the host
+    # path accumulates in fp64, so the clip factor can differ in the last
+    # ulps — opt-in, and update() always keeps the host path).
+    device_norm: bool = False
 
     def init(self, params_tree: Any) -> None:
         import jax
@@ -226,6 +237,7 @@ class HostOffloadAdamW:
         self._schedule = warmup_decay_schedule(
             self.cfg.learning_rate, self.cfg.total_steps, self.cfg.warmup_steps)
         self._native = _load_native()
+        self._norm_sq_jit = None
         self.last_timings: dict = {}
 
     # -- master access ----------------------------------------------------
@@ -354,15 +366,19 @@ class HostOffloadAdamW:
 
             norm_sq = float(multihost_utils.process_allgather(
                 np.asarray(norm_sq, np.float64)).sum())
-        norm = float(np.sqrt(norm_sq))
+        lr, grad_scale = self._clip_and_advance(float(np.sqrt(norm_sq)))
+        return grad_np, lr, grad_scale
+
+    def _clip_and_advance(self, norm: float) -> tuple[float, float]:
+        """Shared epilogue of both norm paths: clip factor from the global
+        norm, step count, lr sample, telemetry."""
         clip = self.cfg.max_grad_norm
         grad_scale = clip / norm if (clip and norm > clip) else 1.0
-
         self.step_count += 1
         lr = float(self._schedule(self.step_count - 1))
         self.last_lr = lr
         self.last_grad_norm = norm
-        return grad_np, lr, grad_scale
+        return lr, grad_scale
 
     def _apply_shard(self, shard: _Shard, g: np.ndarray, lr: float,
                      grad_scale: float) -> None:
@@ -393,6 +409,29 @@ class HostOffloadAdamW:
         self.last_timings = {"d2h_norm_ms": 1000 * (t1 - t0),
                              "update_ms": 1000 * (t2 - t1)}
 
+    def _norm_sq_and_step(self, glvs: list) -> tuple[float, float]:
+        """Device-side global grad norm: one fused fp32 reduction (exactly
+        optax.clip_by_global_norm's accumulation) whose replicated scalar is
+        the only thing the host blocks on — dispatched BEFORE the per-leaf
+        D2H stream so it lands while the leaves are still on the wire. Under
+        multi-process, GSPMD inserts the cross-host reduction; every process
+        calls this every step, so the collective stays uniform. Returns
+        (lr, grad_scale) and advances the step count."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._norm_sq_jit is None:
+            # accumulate in fp32 regardless of grad dtype (gpipe grads can
+            # arrive bf16): a bf16 norm carries ~8 mantissa bits — wrong
+            # clipping decisions near the threshold
+            self._norm_sq_jit = jax.jit(
+                lambda gs: sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                               for g in gs))
+        norm_sq_dev = self._norm_sq_jit(glvs)
+        for g in glvs:
+            g.copy_to_host_async()
+        return self._clip_and_advance(float(jnp.sqrt(norm_sq_dev)))
+
     def update_and_refresh(self, grads_tree: Any, dtype=None) -> Any:
         """One clipped AdamW step AND the fresh device working copy, software-
         pipelined per leaf: leaf i's bf16 cast + H2D upload are dispatched
@@ -400,8 +439,14 @@ class HostOffloadAdamW:
         overlaps leaf i+1's AdamW kernel instead of waiting for the whole
         update (the SURVEY §7.3-item-3 stall: a serial
         update-everything-then-upload-everything step leaves the device idle
-        for the full sum of both phases). Numerics identical to
-        `update()` + `device_params()` — same kernels, same order.
+        for the full sum of both phases).
+
+        With `device_norm` (the trainer's default) the full-tree D2H barrier
+        goes too: the clip factor comes from a device-side reduction, so the
+        loop additionally overlaps leaf i+1's DOWNLOAD with leaf i's AdamW —
+        end-to-end streaming, phase keys norm_ms / stream_d2h_update_h2d_ms.
+        Otherwise numerics are identical to `update()` + `device_params()` —
+        same kernels, same order.
 
         Safe against in-place master mutation: each upload reads a freshly
         allocated cast buffer, never `shard.p` itself."""
@@ -409,12 +454,23 @@ class HostOffloadAdamW:
         import jax.numpy as jnp
 
         t0 = time.perf_counter()
-        grad_np, lr, grad_scale = self._gather_grads_and_norm(
-            self._check_tree(grads_tree))
+        glvs = self._check_tree(grads_tree)
+        streaming = self.device_norm and all(
+            hasattr(g, "copy_to_host_async") for g in glvs)
+        if streaming:
+            lr, grad_scale = self._norm_sq_and_step(glvs)
+            grad_np = None
+        else:
+            grad_np, lr, grad_scale = self._gather_grads_and_norm(glvs)
         t1 = time.perf_counter()
         dtype = dtype or jnp.bfloat16
         vals = []
-        for leaf, gnp in zip(self._leaves, grad_np):
+        for i, (leaf, g) in enumerate(zip(self._leaves, glvs)):
+            # streaming: block on THIS leaf's transfer only (later leaves
+            # keep landing while this one updates)
+            gnp = (grad_np[i] if grad_np is not None else
+                   {k: np.ascontiguousarray(np.asarray(v, np.float32))
+                    for k, v in leaf.grad_shards(g).items()})
             cast = {}
             for key, shard in leaf.shards.items():
                 self._apply_shard(shard, gnp[key], lr, grad_scale)
@@ -423,9 +479,13 @@ class HostOffloadAdamW:
             # leaf's AdamW kernels run while these bytes are on the wire
             vals.append(leaf.assemble(cast))
         t2 = time.perf_counter()
-        # fresh dict: no stale keys from the separate-phase path
-        self.last_timings = {"d2h_norm_ms": 1000 * (t1 - t0),
-                             "update_h2d_ms": 1000 * (t2 - t1)}
+        # fresh dict: no stale keys from the other step paths
+        if streaming:
+            self.last_timings = {"norm_ms": 1000 * (t1 - t0),
+                                 "stream_d2h_update_h2d_ms": 1000 * (t2 - t1)}
+        else:
+            self.last_timings = {"d2h_norm_ms": 1000 * (t1 - t0),
+                                 "update_h2d_ms": 1000 * (t2 - t1)}
         return jax.tree_util.tree_unflatten(self._treedef, vals)
 
     # -- checkpoint integration ------------------------------------------

@@ -870,7 +870,11 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
         # layouts, and the dead donate only emits unusable-buffer warnings.)
         stacked_template = jax.jit(
             lambda p: p, out_shardings=z2_shardings)(stacked_template)
-    host = HostOffloadAdamW(ocfg)
+    # device-side grad norm (default): frees the fused step to stream
+    # leaf-by-leaf instead of waiting for the full-tree grad D2H before the
+    # first AdamW; offload_device_norm: false restores the host fp64 norm
+    host = HostOffloadAdamW(ocfg,
+                            device_norm=cfg.get("offload_device_norm", True))
     host.init(stacked_template)
     # fp32 masters now live on the host; drop the device fp32 init copy and
     # keep only SHARDED abstract structs as the template (HBM holds just the

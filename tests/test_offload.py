@@ -191,3 +191,37 @@ def test_mismatched_tree_raises(tree):
     h.init(tree)
     with pytest.raises(ValueError, match="does not match"):
         h.update({"a": jnp.zeros((64, 32))})
+
+
+def test_device_norm_streaming_matches_host_norm(tree, devices):
+    """The streaming fused step (device-side global norm, the trainer's
+    default) matches the host-norm path within fp32-vs-fp64 norm-accumulation
+    tolerance — WITH clipping active (grads_like's *2 against clip 1.0), so
+    the grad_scale actually depends on the norm under test."""
+    mesh = make_mesh(MeshConfig(pp=2, dp=2))
+    shard_specs = {"a": P("pp"), "b": {"c": P()}}
+    put = lambda t: jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), t, shard_specs)
+    cfg = OptimizerConfig(learning_rate=1e-2, weight_decay=0.1,
+                          max_grad_norm=1.0, total_steps=100, warmup_steps=10)
+
+    h_host = off.HostOffloadAdamW(cfg)
+    h_host.init(put(tree))
+    h_dev = off.HostOffloadAdamW(cfg, device_norm=True)
+    h_dev.init(put(tree))
+
+    for step in range(3):
+        g = put(grads_like(tree, step))
+        if step == 2:  # gpipe can hand the optimizer bf16 grads: the device
+            # norm must cast to fp32 before accumulating (8 mantissa bits
+            # would move the clip factor ~0.4%)
+            g = jax.tree.map(lambda x: x.astype(jnp.bfloat16), g)
+        dev_a = h_host.update_and_refresh(g, jnp.float32)
+        dev_b = h_dev.update_and_refresh(g, jnp.float32)
+        assert "stream_d2h_update_h2d_ms" in h_dev.last_timings
+        assert "d2h_norm_ms" in h_host.last_timings
+        np.testing.assert_allclose(h_dev.last_grad_norm, h_host.last_grad_norm,
+                                   rtol=1e-6)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-6, atol=1e-7),
+            dev_a, dev_b)
