@@ -36,8 +36,10 @@ if [ -d "$OUT/trace" ]; then
     echo "top-op table -> $OUT/top_ops.txt" | tee -a "$OUT/log.txt"
 fi
 
-# 3. memory-estimate calibration (AOT compiles only)
-timeout 1100 python tools/preflight.py --calibrate \
+# 3. memory-estimate calibration (AOT compiles only). TPU backend only:
+# the cpu half costs ~25 min of XLA-CPU compile on this 1-core host and is
+# obtainable offline anytime — don't spend the live-chip window on it.
+CALIBRATE_BACKENDS=tpu timeout 1100 python tools/preflight.py --calibrate \
     > "$OUT/calibrate.txt" 2>&1
 echo "calibrate rc=$?" | tee -a "$OUT/log.txt"
 

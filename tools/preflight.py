@@ -217,8 +217,20 @@ def calibrate() -> dict:
     gib = 1 << 30
     out: dict = {"model": "bench-550m", "batch": 8, "seq": 512}
     # cpu FIRST: a wedged TPU tunnel hangs the tpu compile, and the caller's
-    # timeout should still have the cpu half on stdout by then
-    for backend in ("cpu", "tpu"):
+    # timeout should still have the cpu half on stdout by then. The cpu half
+    # alone costs ~25 min of XLA-CPU compile on a 1-core host, so callers
+    # racing a live-chip window (tools/chip_sprint.sh) can select backends:
+    # CALIBRATE_BACKENDS=tpu skips it (the cpu number is obtainable offline).
+    backends = tuple(b.strip().lower()
+                     for b in os.environ.get("CALIBRATE_BACKENDS",
+                                             "cpu,tpu").split(",")
+                     if b.strip())
+    if "tpu" not in backends:
+        # cpu-only run: pin the platform so jax never initializes the axon
+        # TPU client at all (a wedged/failing tunnel otherwise poisons even
+        # the jax.devices("cpu") lookup)
+        jax.config.update("jax_platforms", "cpu")
+    for backend in backends:
         try:
             devices = jax.devices(backend)
         except RuntimeError as e:
@@ -314,10 +326,12 @@ def main(argv: list[str] | None = None) -> None:
                         "differ per config), and print a summary table; "
                         "exit 1 if any fails")
     p.add_argument("--calibrate", action="store_true",
-                   help="compile the bench config on BOTH the real TPU and "
-                        "XLA-CPU and print both memory_analysis() peaks — "
-                        "the error bar for every CPU-estimate verdict "
-                        "(needs the TPU tunnel; AOT only, runs nothing)")
+                   help="compile the bench config on the real TPU and/or "
+                        "XLA-CPU (CALIBRATE_BACKENDS=cpu,tpu — default "
+                        "both; cpu alone costs ~25 min of XLA-CPU compile) "
+                        "and print each memory_analysis() peak — the error "
+                        "bar for every CPU-estimate verdict (tpu needs the "
+                        "tunnel; AOT only, runs nothing)")
     p.add_argument("overrides", nargs="*", help="key=value config overrides")
     args, unknown = p.parse_known_args(argv)
     bad = [u for u in unknown if not (u.startswith("--") and "=" in u)]
