@@ -11,6 +11,10 @@ set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-sprint}"
 mkdir -p "$OUT"
+# Persistent XLA compile cache: a sprint aborted by a re-wedge leaves its
+# compiled programs behind, so the NEXT attempt skips straight to execution
+# (the sweep's ~9 compiles are most of its chip time).
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}"
 echo "chip sprint start: $(date -u +%FT%TZ)" | tee "$OUT/log.txt"
 
 # 1+2. bench with profiling in ONE sweep: bench.py prints (banks) the result
