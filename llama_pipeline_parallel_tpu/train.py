@@ -434,9 +434,18 @@ def _release_preemption_handlers() -> None:
 def run_training(cfg: dict) -> dict:
     """The full training run; returns a summary dict for programmatic callers."""
     _install_preemption_handlers()
+    # jax settings are process-global: save/restore around the run so a later
+    # run_training in the same process doesn't inherit this config's cache
+    prev_cache = jax.config.jax_compilation_cache_dir
+    if cfg.get("compilation_cache_dir"):
+        # Persistent XLA compile cache: a 65B pipeline step costs minutes of
+        # compile per topology; resumes/restarts on the same pod skip it.
+        jax.config.update("jax_compilation_cache_dir",
+                          str(cfg["compilation_cache_dir"]))
     try:
         return _run_training(cfg)
     finally:
+        jax.config.update("jax_compilation_cache_dir", prev_cache)
         _release_preemption_handlers()
 
 

@@ -3,6 +3,8 @@
 import json
 import os
 
+import jax
+
 import numpy as np
 import pytest
 
@@ -38,6 +40,17 @@ def test_smoke_run_writes_metrics_and_ckpt(tmp_path, devices):
     assert lines and {"loss", "lr", "tokens_per_sec"} <= set(lines[0])
     assert os.path.isdir(os.path.join(out, "checkpoint-4"))
     assert os.path.exists(os.path.join(out, "training_config.json"))
+
+
+def test_compilation_cache_dir_knob(tmp_path, devices):
+    """`compilation_cache_dir` populates a persistent XLA compile cache —
+    restarts of a big run skip the minutes-long compiles."""
+    cache = tmp_path / "xla_cache"
+    prev = jax.config.jax_compilation_cache_dir
+    run_training(base_cfg(tmp_path, compilation_cache_dir=str(cache)))
+    assert cache.is_dir() and any(cache.iterdir())
+    # run_training save/restores the process-global jax setting itself
+    assert jax.config.jax_compilation_cache_dir == prev
 
 
 @pytest.mark.slow
