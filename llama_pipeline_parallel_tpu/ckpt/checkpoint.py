@@ -33,6 +33,7 @@ from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
 from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
 from llama_pipeline_parallel_tpu.parallel import distributed as dist
 from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+from llama_pipeline_parallel_tpu.utils import trace
 from llama_pipeline_parallel_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -237,29 +238,36 @@ class CheckpointManager:
         """
         self.finalize()
         path = self.step_dir(step)
-        self._ckptr.save(os.path.join(path, "params"),
-                         pl.unstack_stages(params_stacked, manifest), force=True)
-        if opt_state is not None:
-            self._ckptr.save(os.path.join(path, "opt"),
-                             _canonicalize_moments(opt_state, manifest, to_canonical=True),
-                             force=True)
+        # the span covers what the TRAINING LOOP pays for: the synchronous
+        # D2H copy (and, when blocking, the full commit); the async tail is
+        # its own `ckpt_commit` span on the commit thread, visible in
+        # spans.jsonl but excluded from the RunClock's wall-time buckets
+        with trace.span("ckpt_save", step=step, blocking=blocking):
+            self._ckptr.save(os.path.join(path, "params"),
+                             pl.unstack_stages(params_stacked, manifest), force=True)
+            if opt_state is not None:
+                self._ckptr.save(os.path.join(path, "opt"),
+                                 _canonicalize_moments(opt_state, manifest, to_canonical=True),
+                                 force=True)
 
-        def commit():
-            self._commit(path, step, manifest, cfg,
-                         has_optimizer_state=opt_state is not None)
-            if on_complete is not None:
-                on_complete(path)
-            if keep_last:  # None/0 both mean "no retention limit"
-                self.prune(keep_last)
+            def commit():
+                self._commit(path, step, manifest, cfg,
+                             has_optimizer_state=opt_state is not None)
+                if on_complete is not None:
+                    on_complete(path)
+                if keep_last:  # None/0 both mean "no retention limit"
+                    self.prune(keep_last)
 
-        if blocking:
-            commit()
-        else:
+            if blocking:
+                commit()
+
+        if not blocking:
             import threading
 
             def guarded():
                 try:
-                    commit()
+                    with trace.span("ckpt_commit", step=step):
+                        commit()
                 except BaseException as e:  # surfaced by finalize()
                     self._pending_error = e
 
@@ -280,20 +288,21 @@ class CheckpointManager:
         commit; None/0 disable)."""
         self.finalize()
         path = self.step_dir(step)
-        self._ckptr.save(os.path.join(path, "params"),
-                         pl.unstack_stages(host.masters_tree(), manifest),
-                         force=True)
-        self._ckptr.wait_until_finished()
-        for attr in ("m", "v"):
-            self._ckptr.save(os.path.join(path, f"opt_{attr}"),
-                             pl.unstack_stages(host.moments_tree(attr), manifest),
+        with trace.span("ckpt_save", step=step, blocking=True, offload=True):
+            self._ckptr.save(os.path.join(path, "params"),
+                             pl.unstack_stages(host.masters_tree(), manifest),
                              force=True)
             self._ckptr.wait_until_finished()
-        self._commit(path, step, manifest, cfg, has_optimizer_state=True,
-                     opt_layout="offload_parts",
-                     opt_step_count=int(host.step_count))
-        if keep_last:
-            self.prune(keep_last)
+            for attr in ("m", "v"):
+                self._ckptr.save(os.path.join(path, f"opt_{attr}"),
+                                 pl.unstack_stages(host.moments_tree(attr), manifest),
+                                 force=True)
+                self._ckptr.wait_until_finished()
+            self._commit(path, step, manifest, cfg, has_optimizer_state=True,
+                         opt_layout="offload_parts",
+                         opt_step_count=int(host.step_count))
+            if keep_last:
+                self.prune(keep_last)
         return path
 
     def _commit(self, path: str, step: int, manifest: StageManifest,
@@ -344,10 +353,11 @@ class CheckpointManager:
         """Module-only warm start (reference `load_module_only=True`,
         trainer_base_ds_mp.py:284): restores params into the CURRENT
         topology's stacked layout, regardless of the PP degree at save time."""
-        canonical = pl.unstack_stages(params_template_stacked, manifest)
-        restored = self._ckptr.restore(
-            os.path.join(self.step_dir(step), "params"), _abstract(canonical))
-        return pl.stack_stages(restored, manifest)
+        with trace.span("ckpt_restore", step=step, item="params"):
+            canonical = pl.unstack_stages(params_template_stacked, manifest)
+            restored = self._ckptr.restore(
+                os.path.join(self.step_dir(step), "params"), _abstract(canonical))
+            return pl.stack_stages(restored, manifest)
 
     def load_offload_moments(self, step: int, params_template_stacked: dict,
                              manifest: StageManifest) -> tuple[dict, dict, int]:
@@ -360,11 +370,12 @@ class CheckpointManager:
                 f"optimizer (opt_layout={meta.get('opt_layout')!r})")
         canonical = pl.unstack_stages(params_template_stacked, manifest)
         out = []
-        for attr in ("m", "v"):
-            restored = self._ckptr.restore(
-                os.path.join(self.step_dir(step), f"opt_{attr}"),
-                _abstract(canonical))
-            out.append(pl.stack_stages(restored, manifest))
+        with trace.span("ckpt_restore", step=step, item="offload_moments"):
+            for attr in ("m", "v"):
+                restored = self._ckptr.restore(
+                    os.path.join(self.step_dir(step), f"opt_{attr}"),
+                    _abstract(canonical))
+                out.append(pl.stack_stages(restored, manifest))
         return out[0], out[1], int(meta["opt_step_count"])
 
     def load(self, step: int, params_template_stacked: dict, opt_template: Any,
@@ -382,10 +393,11 @@ class CheckpointManager:
                 f"optimizer_offload: true, or warm-start module-only via "
                 f"model_name_or_path")
         params = self.load_params(step, params_template_stacked, manifest)
-        opt_canonical = _canonicalize_moments(opt_template, manifest, to_canonical=True)
-        restored_opt = self._ckptr.restore(
-            os.path.join(self.step_dir(step), "opt"), _abstract(opt_canonical))
-        opt_state = _canonicalize_moments(restored_opt, manifest, to_canonical=False)
+        with trace.span("ckpt_restore", step=step, item="opt"):
+            opt_canonical = _canonicalize_moments(opt_template, manifest, to_canonical=True)
+            restored_opt = self._ckptr.restore(
+                os.path.join(self.step_dir(step), "opt"), _abstract(opt_canonical))
+            opt_state = _canonicalize_moments(restored_opt, manifest, to_canonical=False)
         return params, opt_state, int(meta["step"])
 
 

@@ -126,7 +126,13 @@ class PrefetchIterator:
 
     Wraps any batch iterator; `depth` bounds buffered batches. Exceptions in
     the producer re-raise on the consumer side.
-    """
+
+    Stall telemetry: a `__next__` that finds the buffer EMPTY means the
+    producer lost the race with the device — the consumer's blocked time is
+    recorded as a nested `prefetch_stall` span (inside the trainer's
+    `data_wait`) and accumulated in `stall_seconds`/`stalls`, so an
+    input-bound run is diagnosable from spans.jsonl alone (deepen
+    `prefetch_depth`, or the dataset/collator is too slow)."""
 
     _DONE = object()
 
@@ -140,6 +146,8 @@ class PrefetchIterator:
                 f"UNBOUNDED buffering of an infinite loader)")
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._err: list[BaseException] = []
+        self.stalls = 0
+        self.stall_seconds = 0.0
 
         def produce():
             try:
@@ -162,7 +170,15 @@ class PrefetchIterator:
             if self._err:
                 raise self._err[0]
             raise StopIteration
-        item = self._queue.get()
+        if self._queue.empty():  # producer behind: the blocked get is a stall
+            from llama_pipeline_parallel_tpu.utils import trace
+
+            with trace.span("prefetch_stall") as rec:
+                item = self._queue.get()
+            self.stalls += 1
+            self.stall_seconds += rec["dur"]
+        else:
+            item = self._queue.get()
         if item is self._DONE:
             self._finished = True
             if self._err:

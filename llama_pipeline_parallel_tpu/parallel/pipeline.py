@@ -55,7 +55,6 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from llama_pipeline_parallel_tpu.models.llama import model as llama
@@ -70,6 +69,8 @@ from llama_pipeline_parallel_tpu.parallel.mesh import (
     AXIS_SP,
     AXIS_TP,
 )
+from llama_pipeline_parallel_tpu.utils import compat
+from llama_pipeline_parallel_tpu.utils.compat import shard_map
 
 Params = dict
 Batch = dict
@@ -159,6 +160,32 @@ class PipelineConfig:
                     f"layer_counts has {len(self.layer_counts)} entries for "
                     f"num_stages={self.num_stages}")
         llama.resolve_remat_policy(self.remat_policy)  # fail fast on typos
+
+
+def bubble_fraction(pcfg: PipelineConfig) -> float:
+    """Analytic pipeline-bubble estimate for THIS implementation's lockstep
+    scan schedules, reported next to MFU so schedule regressions are visible
+    without a profiler (the measured breakdown OptPipe/SkipPipe-style
+    schedule work optimizes against — PAPERS.md).
+
+    Both schedules run S stages over M microbatches in `accum_chunks` (= c)
+    sequential flushes of m = M/c microbatches, every tick the same cost
+    across stages (in-jit scan: warmup/drain ticks take a full tick's wall
+    time even where a stage's slot is masked):
+
+    - "1f1b": each flush scans m + 2(S-1) combined fwd+bwd ticks
+      (`_pipeline_1f1b_local`'s num_ticks) of which m are useful per stage
+      -> bubble = 2c(S-1) / (M + 2c(S-1)).
+    - "gpipe": the forward scan is m + S - 1 ticks and the AD transpose
+      mirrors it, m useful each way
+      -> bubble = c(S-1) / (M + c(S-1)).
+    """
+    s = pcfg.num_stages
+    if s <= 1:
+        return 0.0
+    m, c = pcfg.num_microbatches, pcfg.accum_chunks
+    per_flush = 2 * (s - 1) if pcfg.schedule == "1f1b" else (s - 1)
+    return per_flush * c / (m + per_flush * c)
 
 
 # ---------------------------------------------------------------------------
@@ -448,8 +475,8 @@ def _pipeline_loss_local(
     num_ticks = m_total + s_total - 1
     hidden_shape = (mb, seqlen, cfg.hidden_size)
     x_init = jnp.zeros(hidden_shape, cfg.dtype)
-    tp_size = jax.lax.axis_size(AXIS_TP)
-    sp_size = jax.lax.axis_size(AXIS_SP)
+    tp_size = compat.axis_size(AXIS_TP)
+    sp_size = compat.axis_size(AXIS_SP)
     # seqlen here is the LOCAL slab length; fallback positions must be global
     sp_pos_base = jax.lax.axis_index(AXIS_SP) * seqlen if sp_size > 1 else 0
 
@@ -601,9 +628,9 @@ def _pipeline_1f1b_local(
     stage = jax.lax.axis_index(AXIS_PP)
     is_first = stage == 0
     is_last = stage == s_total - 1
-    tp_size = jax.lax.axis_size(AXIS_TP)
+    tp_size = compat.axis_size(AXIS_TP)
     tp_axis = AXIS_TP if tp_size > 1 else None
-    sp_size = jax.lax.axis_size(AXIS_SP)
+    sp_size = compat.axis_size(AXIS_SP)
 
     ids = batch["input_ids"]
     bsz, seqlen = ids.shape
@@ -777,7 +804,7 @@ def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn):
     computed up front and the differentiated function stays psum-free.
     """
     labels = batch["labels"]
-    sp_size = jax.lax.axis_size(AXIS_SP)
+    sp_size = compat.axis_size(AXIS_SP)
     # valid-target count of this shard's slab (sp shards see boundary-crossing
     # targets via _sp_shift_labels, so counts add up exactly to the global one)
     local_count = (_sp_shift_labels(labels, sp_size) != llama.IGNORE_INDEX).sum()
@@ -852,7 +879,7 @@ def make_pipeline_eval_fn(
 
     def local(params, batch):
         labels = batch["labels"]
-        sp_size = jax.lax.axis_size(AXIS_SP)
+        sp_size = compat.axis_size(AXIS_SP)
         count = jax.lax.psum(
             (_sp_shift_labels(labels, sp_size) != llama.IGNORE_INDEX).sum(),
             (AXIS_DP, AXIS_SP))

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import signal
+import time
 from typing import Any, Iterator
 
 import jax
@@ -49,6 +50,7 @@ from llama_pipeline_parallel_tpu.parallel.distributed import (
     initialize_distributed,
 )
 from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
+from llama_pipeline_parallel_tpu.utils import trace
 from llama_pipeline_parallel_tpu.utils.config import instantiate
 from llama_pipeline_parallel_tpu.utils.logging import get_logger
 from llama_pipeline_parallel_tpu.utils.metrics import (
@@ -431,6 +433,20 @@ def _release_preemption_handlers() -> None:
     _INSTALLED_SIGNALS.clear()
 
 
+def _reset_compilation_cache() -> None:
+    """Re-initialize jax's persistent compile cache so a mid-process
+    jax_compilation_cache_dir change takes effect. Best-effort: the helper
+    is a jax-internal module, and a miss only costs cache reuse."""
+    try:
+        from jax.experimental.compilation_cache import compilation_cache
+
+        compilation_cache.reset_cache()
+    except Exception as e:  # jax internals moved — keep training
+        logger.warning("could not reset the XLA compile cache (%r); the "
+                       "compilation_cache_dir change may not apply to this "
+                       "process", e)
+
+
 def run_training(cfg: dict) -> dict:
     """The full training run; returns a summary dict for programmatic callers."""
     _install_preemption_handlers()
@@ -442,10 +458,17 @@ def run_training(cfg: dict) -> dict:
         # compile per topology; resumes/restarts on the same pod skip it.
         jax.config.update("jax_compilation_cache_dir",
                           str(cfg["compilation_cache_dir"]))
+        # the cache object initializes lazily ONCE per process — if an earlier
+        # run in this process already compiled anything, the dir change is
+        # silently ignored until the cache is reset
+        _reset_compilation_cache()
     try:
         return _run_training(cfg)
     finally:
-        jax.config.update("jax_compilation_cache_dir", prev_cache)
+        if cfg.get("compilation_cache_dir"):
+            jax.config.update("jax_compilation_cache_dir", prev_cache)
+            _reset_compilation_cache()  # later runs must not inherit the dir
+        trace.configure(None)  # close this run's spans.jsonl writer
         _release_preemption_handlers()
 
 
@@ -454,6 +477,9 @@ def _run_training(cfg: dict) -> dict:
     output_dir = cfg["output_dir"]
 
     initialize_distributed()  # no-op unless a pod coordinator is configured
+    # Span stream from here on: everything until the step loop starts is the
+    # `init` bucket (model build, checkpoint restore, first-batch probe).
+    trace.configure(output_dir, write=jax.process_index() == 0)
     mesh_cfg = MeshConfig(**cfg.get("mesh", {}))
     mesh = make_mesh(mesh_cfg)
     model_cfg = build_model_config(cfg["model"])
@@ -583,7 +609,8 @@ def _run_training(cfg: dict) -> dict:
         final_loss, preempted_at = _train_loop(
             cfg, model_cfg, mesh, loader, seq_length,
             resume_step, end_step, do_step, do_save, do_eval,
-            extra_scalars=_packing_scalars(collator))
+            extra_scalars=_packing_scalars(collator),
+            static_scalars={"bubble_fraction": round(pl.bubble_fraction(pcfg), 4)})
     except BaseException:
         # join the in-flight commit, but never let ITS failure replace the
         # training exception that actually killed the run
@@ -687,14 +714,18 @@ def _packing_scalars(collator) -> Any:
 
 
 def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
-                do_step, do_save, do_eval=None, extra_scalars=None) -> tuple:
+                do_step, do_save, do_eval=None, extra_scalars=None,
+                static_scalars=None) -> tuple:
     """The shared step/log/save/profile loop for both optimizer paths.
 
     `do_step(batch) -> (loss_scalar, scalars_thunk)`; the thunk is only called
     at logging boundaries so the hot loop never blocks on a D2H sync.
     `do_save(step)` writes a full checkpoint. `do_eval() -> float` (optional)
     runs every `eval_steps`. `extra_scalars() -> dict` (optional) contributes
-    host-side counters (e.g. packing drop rate) to every metrics line.
+    host-side counters (e.g. packing drop rate) to every metrics line;
+    `static_scalars` (optional dict) are run constants (e.g. the schedule's
+    bubble fraction) repeated on every line so downstream joins need no
+    second file.
     """
     output_dir = cfg["output_dir"]
     # Scalars are replicated across processes: process 0 writes for the pod
@@ -710,6 +741,29 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                        global_scale=mesh.shape["dp"] / local_dp)
     logging_steps = cfg.get("logging_steps", 10)
     save_steps = cfg.get("save_steps", 0)
+
+    # ---- run-health telemetry (docs/OBSERVABILITY.md) ---------------------
+    # Everything since trace.configure() — model build, restore, data probe —
+    # is the init bucket; record it retroactively as a span so the offline
+    # goodput report's bucket sum matches wall-clock.
+    rec = trace.recorder()
+    rec.emit("init", rec.configured_at, time.time() - rec.configured_at)
+    # Resume carries the previous incarnation's cumulative buckets forward:
+    # goodput stays a whole-run number, and the wall time the preemption
+    # threw away surfaces as badput instead of vanishing with the restart.
+    prior = trace.load_health(output_dir) if resume_step else None
+    init_secs = time.time() - rec.configured_at
+    clock = trace.RunClock(prior=(prior or {}).get("clock"),
+                           already_elapsed=init_secs)
+    clock.add("init", init_secs)
+    rec.add_listener(clock.on_span)
+    heartbeat = (trace.Heartbeat(output_dir, clock,
+                                 interval=cfg.get("health_interval", 10.0))
+                 if jax.process_index() == 0 else None)
+    peak_bytes, peak_src = trace.device_peak_bytes()
+    logger.info("device memory telemetry: %s (%s)",
+                "unavailable" if peak_bytes is None else f"{peak_bytes} B peak",
+                peak_src)
 
     # Optional profiler capture window: profile_steps: [start, stop] writes a
     # tensorboard/Perfetto trace under <output_dir>/profile (SURVEY.md §5.1 —
@@ -728,8 +782,10 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
     trace_active = False
 
     it: Iterator = iter(RepeatingLoader(loader))
-    for _ in range(resume_step):  # dataloader fast-forward (reference :345-351)
-        next(it)
+    if resume_step:  # dataloader fast-forward (reference :345-351) — minutes
+        with trace.span("data_wait", fast_forward=resume_step):  # at scale
+            for _ in range(resume_step):
+                next(it)
     it = PrefetchIterator(it, depth=cfg.get("prefetch_depth", 2))
 
     # Preemption-aware save (SURVEY.md §5.3): on a preemption notice —
@@ -747,6 +803,8 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
     # every host (the decision must never depend on a host-local flag, or the
     # allgather call counts diverge and the pod hangs).
     check_every = max(int(cfg.get("preempt_check_every", 10)), 1)
+    window_t0 = time.perf_counter()
+    window_overhead = 0.0  # compile/eval/ckpt seconds to exclude from step_time
 
     try:
         for step in range(resume_step, end_step):
@@ -774,8 +832,22 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                     and step < profile_window[1]:
                 jax.profiler.start_trace(os.path.join(output_dir, "profile"))
                 trace_active = True
-            batch = next(it)
-            loss, scalars_thunk = do_step(batch)
+            with trace.span("data_wait", step=step):
+                batch = next(it)
+            if step == resume_step:
+                # First step: trace+XLA-compile happen synchronously inside
+                # the dispatch, and the value barrier catches the rest — so
+                # the whole first-step wall time lands in the compile bucket
+                # instead of smearing into the first window's train time.
+                with trace.span("compile_block", step=step) as sp:
+                    loss, scalars_thunk = do_step(batch)
+                    jax.block_until_ready(loss)
+                window_overhead += sp["dur"]  # keep compile out of step_time
+            else:
+                with trace.span("step_dispatch", step=step):
+                    loss, scalars_thunk = do_step(batch)
+            if heartbeat is not None:
+                heartbeat.beat(step + 1)
             if trace_active and (step + 1 >= profile_window[1] or step + 1 == end_step):
                 jax.block_until_ready(loss)
                 jax.profiler.stop_trace()
@@ -787,22 +859,49 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                          real_tokens=None if mask is None
                          else int((mask != 0).sum()))
             if (step + 1) % logging_steps == 0 or step + 1 == end_step:
-                final_loss = float(losses[-1])
+                n_window = len(losses)
+                # the value fetch is the loop's sync point: its wall time is
+                # the device executing the window's steps (minus what the
+                # dispatch/data spans already took on the host side)
+                with trace.span("device_step", step=step + 1, steps=n_window):
+                    final_loss = float(losses[-1])
+                # pure stepping time: compile/eval/ckpt wall time inside the
+                # window is subtracted, so step_time tracks the train rate
+                # (those phases are visible in the goodput buckets instead)
+                step_dur = max(time.perf_counter() - window_t0 - window_overhead,
+                               0.0) / max(n_window, 1)
+                window_t0 = time.perf_counter()
+                window_overhead = 0.0
+                peak_bytes, _ = trace.device_peak_bytes()
                 writer.log(step + 1, {"loss": float(np.mean([float(l) for l in losses])),
                                       **scalars_thunk(), **meter.read_and_reset(),
-                                      **(extra_scalars() if extra_scalars else {})})
+                                      **(extra_scalars() if extra_scalars else {}),
+                                      **(static_scalars or {}),
+                                      "goodput": round(clock.goodput(), 4),
+                                      "step_time": round(step_dur, 4),
+                                      "device_peak_bytes": peak_bytes})
+                if heartbeat is not None:
+                    heartbeat.beat(step + 1, step_dur)
                 losses.clear()
             eval_steps = cfg.get("eval_steps", 0)
             if do_eval is not None and eval_steps and (step + 1) % eval_steps == 0:
-                writer.log(step + 1, {"eval_loss": do_eval()})
+                with trace.span("eval", step=step + 1) as sp:
+                    eval_loss = do_eval()
+                writer.log(step + 1, {"eval_loss": eval_loss})
+                window_overhead += sp["dur"]
             if save_steps and (step + 1) % save_steps == 0:
+                t_save = time.perf_counter()
                 do_save(step + 1)
                 last_saved = step + 1
+                window_overhead += time.perf_counter() - t_save
     finally:
         if trace_active:  # preemption break / exception inside the window
             jax.profiler.stop_trace()
             logger.info("profiler trace (early exit) written to %s/profile", output_dir)
         writer.close()
+        if heartbeat is not None:
+            heartbeat.stop()  # kills the daemon on every exit path; write()
+            # below still works for the final save's post-stop refresh
         # The loop is over on every path out of here: nothing re-checks
         # _STOP_SIGNALS anymore, so holding the graceful handlers would
         # silently swallow a Ctrl+C during the final save or during
@@ -812,6 +911,8 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
         _release_preemption_handlers()
     if cfg.get("save_final", True) and last_saved != end_step:
         do_save(end_step, final=True)
+        if heartbeat is not None:  # clock listener saw the ckpt_save span;
+            heartbeat.write()      # fold the final save into health.json
     return final_loss, preempted_at
 
 
@@ -980,6 +1081,7 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
     final_loss, preempted_at = _train_loop(
         cfg, model_cfg, mesh, loader, seq_length,
         resume_step, end_step, do_step, do_save, do_eval,
-        extra_scalars=_packing_scalars(collator))
+        extra_scalars=_packing_scalars(collator),
+        static_scalars={"bubble_fraction": round(pl.bubble_fraction(pcfg), 4)})
     return _summarize(final_loss, preempted_at, end_step, len(loader),
                       output_dir)

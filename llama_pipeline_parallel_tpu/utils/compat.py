@@ -1,0 +1,38 @@
+"""Version-compat shims for JAX API moves.
+
+`shard_map` was promoted from `jax.experimental.shard_map` to the top-level
+`jax.shard_map` namespace (and its replication-check kwarg renamed
+`check_rep` -> `check_vma`); depending on the installed JAX exactly one of
+the two exists. This is the single import site — every module (and test)
+takes `shard_map` from here and writes the NEW (`check_vma`) spelling, so a
+JAX upgrade/downgrade is a one-file fix instead of an 11-file
+test-collection outage.
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:
+    from jax import shard_map  # jax >= 0.6 top-level API, check_vma kwarg
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    @functools.wraps(_experimental_shard_map)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:  # older jax spells it check_rep
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental_shard_map(*args, **kwargs)
+
+try:
+    from jax.lax import axis_size  # jax >= 0.6
+except ImportError:
+    def axis_size(axis_name):
+        """Static mesh-axis size inside shard_map: psum of a literal 1 is
+        constant-folded to a python int on every jax that predates
+        `jax.lax.axis_size`."""
+        import jax
+
+        return jax.lax.psum(1, axis_name)
+
+__all__ = ["shard_map", "axis_size"]

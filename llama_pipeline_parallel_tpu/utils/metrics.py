@@ -44,13 +44,32 @@ def train_flops_per_token(cfg: LlamaConfig, seq_length: int) -> float:
     return 6.0 * param_count(cfg) + 12.0 * cfg.num_hidden_layers * cfg.hidden_size * seq_length
 
 
+_PEAK_FLOPS_LOGGED: set[str] = set()  # one verdict line per device kind
+
+
 def detect_chip_peak_flops() -> float | None:
+    """Peak bf16 FLOP/s for the local chip generation, or None (MFU off).
+
+    The match verdict is logged once per device kind: before this, an
+    unknown/CPU device made `mfu` silently vanish from the metrics line and
+    the operator couldn't tell a meter bug from an unlisted chip."""
     import jax
 
-    kind = jax.devices()[0].device_kind.lower()
+    kind = jax.devices()[0].device_kind
+    first_time = kind not in _PEAK_FLOPS_LOGGED
+    _PEAK_FLOPS_LOGGED.add(kind)
     for key, flops in TPU_PEAK_FLOPS.items():
-        if key in kind:
+        if key in kind.lower():
+            if first_time:
+                logger.info("MFU accounting on: device_kind %r matched "
+                            "TPU_PEAK_FLOPS[%r] = %.0f bf16 TFLOP/s/chip",
+                            kind, key, flops / 1e12)
             return flops
+    if first_time:
+        logger.info("MFU disabled: device_kind %r matches no TPU_PEAK_FLOPS "
+                    "entry (%s) — metrics lines will carry no `mfu` field; "
+                    "add the chip's peak to utils/metrics.py to enable it",
+                    kind, ", ".join(sorted(TPU_PEAK_FLOPS)))
     return None
 
 

@@ -31,7 +31,11 @@ def test_truly_unknown_flag_still_errors():
 
 
 def test_console_script_target_matches_pyproject():
-    import tomllib
+    try:
+        import tomllib  # stdlib, python >= 3.11
+    except ModuleNotFoundError:
+        tomllib = pytest.importorskip(
+            "tomli", reason="needs stdlib tomllib (py3.11+) or tomli")
 
     root = os.path.join(os.path.dirname(__file__), os.pardir)
     with open(os.path.join(root, "pyproject.toml"), "rb") as f:

@@ -3,7 +3,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from llama_pipeline_parallel_tpu.utils.compat import shard_map
 
 from llama_pipeline_parallel_tpu.parallel import mesh as mesh_lib
 from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh

@@ -47,7 +47,19 @@ def test_compilation_cache_dir_knob(tmp_path, devices):
     restarts of a big run skip the minutes-long compiles."""
     cache = tmp_path / "xla_cache"
     prev = jax.config.jax_compilation_cache_dir
-    run_training(base_cfg(tmp_path, compilation_cache_dir=str(cache)))
+    # the tiny program compiles in well under the default 1s persistence
+    # threshold — drop it so the toy run actually writes entries
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        # unique seq length: an identical program compiled by an earlier test
+        # would hit XLA's in-memory cache and never write the persistent one
+        run_training(base_cfg(
+            tmp_path, compilation_cache_dir=str(cache),
+            dataset={"synthetic": True, "seq_length": 24,
+                     "pseudo_dataset_len": 128}))
+    finally:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
     assert cache.is_dir() and any(cache.iterdir())
     # run_training save/restores the process-global jax setting itself
     assert jax.config.jax_compilation_cache_dir == prev

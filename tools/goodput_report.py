@@ -1,0 +1,200 @@
+"""Per-run goodput/badput report from a trainer output dir.
+
+Joins the span stream (`spans.jsonl`, written by utils/trace.py) with the
+scalar stream (`metrics.jsonl`) into the operational picture a TPU run
+lives or dies on: where wall-clock went (time-bucket table), which logging
+windows were slowest, and how bad the input-pipeline stalls were — offline,
+after the run, no profiler capture needed (the Perfetto window covers a few
+steps; the span stream covers the whole run).
+
+Usage:
+  python tools/goodput_report.py <output_dir> [--top 5] [--json]
+
+Follows tools/trace_summary.py's track-summary conventions: one `== section ==`
+per table, durations in ms/s with percentages against the section total.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_jsonl(path: str) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def wall_window(spans: list[dict]) -> tuple[float, float]:
+    """(first span start, last span end) over MAIN-THREAD spans — the run's
+    observed wall window. The trainer emits a retroactive `init` span from
+    trace.configure(), so the window opens at run start; background spans
+    (async checkpoint commits) are excluded, matching the bucket rules."""
+    main = [s for s in spans if s.get("main_thread", True)]
+    if not main:
+        raise SystemExit("no spans to report on")
+    return (min(s["ts"] for s in main),
+            max(s.get("end", s["ts"] + s.get("dur", 0.0)) for s in main))
+
+
+def bucket_table(spans: list[dict]) -> dict[str, float]:
+    """Seconds per RunClock bucket, recomputed from the span stream with the
+    SAME rules the live clock applies (top-level, main-thread spans only —
+    utils/trace.SPAN_BUCKETS), plus `untracked` as the wall remainder, so
+    the table's sum IS the run's wall-clock."""
+    from llama_pipeline_parallel_tpu.utils.trace import SPAN_BUCKETS
+
+    t0, t1 = wall_window(spans)
+    buckets: dict[str, float] = {}
+    for s in spans:
+        if s.get("depth") != 0 or not s.get("main_thread", True):
+            continue
+        bucket = SPAN_BUCKETS.get(s["name"])
+        if bucket is not None:
+            buckets[bucket] = buckets.get(bucket, 0.0) + s["dur"]
+    buckets["untracked"] = max((t1 - t0) - sum(buckets.values()), 0.0)
+    return buckets
+
+
+def slowest_windows(spans: list[dict], metrics: list[dict], top: int
+                    ) -> list[dict]:
+    """Logging windows ranked by per-step wall time: `device_step` spans
+    (one per boundary; `steps` counts the window's steps) joined with the
+    metrics line logged at the same step for loss/goodput context."""
+    # a step can carry several lines (the train scalars, then an eval_loss
+    # line at the same boundary) — merge them so neither shadows the other
+    by_step: dict = {}
+    for m in metrics:
+        by_step.setdefault(m.get("step"), {}).update(m)
+    windows = []
+    for s in spans:
+        if s["name"] != "device_step":
+            continue
+        steps = max(int(s.get("steps", 1)), 1)
+        m = by_step.get(s.get("step"), {})
+        windows.append({
+            "step": s.get("step"),
+            "steps": steps,
+            "block_s": s["dur"],
+            "per_step_s": s["dur"] / steps,
+            "step_time": m.get("step_time"),
+            "loss": m.get("loss"),
+        })
+    windows.sort(key=lambda w: -(w["step_time"] or w["per_step_s"]))
+    return windows[:top]
+
+
+def stall_histogram(spans: list[dict], name: str = "data_wait"
+                    ) -> list[tuple[str, int, float]]:
+    """(label, count, total seconds) per duration decade for one span name.
+    `data_wait` and the nested `prefetch_stall` are histogrammed SEPARATELY —
+    a prefetch stall happens inside its data_wait, so summing both would
+    double-count the stalled seconds."""
+    edges = [(0.001, "<1ms"), (0.01, "1-10ms"), (0.1, "10-100ms"),
+             (1.0, "0.1-1s"), (float("inf"), ">=1s")]
+    hist = {label: [0, 0.0] for _, label in edges}
+    for s in spans:
+        if s["name"] != name:
+            continue
+        for hi, label in edges:
+            if s["dur"] < hi:
+                hist[label][0] += 1
+                hist[label][1] += s["dur"]
+                break
+    return [(label, n, total) for label, (n, total) in hist.items()]
+
+
+def build_report(output_dir: str, top: int = 5) -> dict:
+    spans = load_jsonl(os.path.join(output_dir, "spans.jsonl"))
+    metrics = load_jsonl(os.path.join(output_dir, "metrics.jsonl"))
+    health = None
+    try:
+        with open(os.path.join(output_dir, "health.json")) as f:
+            health = json.load(f)
+    except (OSError, ValueError):
+        pass
+    t0, t1 = wall_window(spans)
+    buckets = bucket_table(spans)
+    wall = t1 - t0
+    return {
+        "output_dir": output_dir,
+        "wall_seconds": wall,
+        "buckets": buckets,
+        "goodput": buckets.get("train", 0.0) / max(wall, 1e-9),
+        "cumulative_goodput": (health or {}).get("goodput"),
+        "last_step": (health or {}).get("last_step"),
+        "slowest_windows": slowest_windows(spans, metrics, top),
+        "stall_histogram": stall_histogram(spans, "data_wait"),
+        "prefetch_stalls": {
+            "count": sum(1 for s in spans if s["name"] == "prefetch_stall"),
+            "seconds": sum(s["dur"] for s in spans
+                           if s["name"] == "prefetch_stall"),
+        },
+        "spans": len(spans),
+        "metrics_lines": len(metrics),
+    }
+
+
+def print_report(rep: dict) -> None:
+    wall = rep["wall_seconds"]
+    print(f"run: {rep['output_dir']}  ({rep['spans']} spans, "
+          f"{rep['metrics_lines']} metrics lines, last step "
+          f"{rep['last_step']})")
+
+    print(f"\n== time buckets: {wall:.2f} s wall ==")
+    for name, secs in sorted(rep["buckets"].items(), key=lambda kv: -kv[1]):
+        pct = 100 * secs / wall if wall else 0.0
+        print(f"  {secs:10.2f} s  {pct:5.1f}%  {name}")
+    print(f"  {sum(rep['buckets'].values()):10.2f} s  total (goodput "
+          f"{100 * rep['goodput']:.1f}%"
+          + (f", cumulative incl. prior incarnations "
+             f"{100 * rep['cumulative_goodput']:.1f}%"
+             if rep["cumulative_goodput"] is not None else "") + ")")
+
+    if rep["slowest_windows"]:
+        print("\n== slowest logging windows (per-step wall time) ==")
+        for w in rep["slowest_windows"]:
+            step_time = w["step_time"]
+            shown = step_time if step_time is not None else w["per_step_s"]
+            loss = f"  loss {w['loss']:.4g}" if w["loss"] is not None else ""
+            print(f"  {1e3 * shown:10.2f} ms/step  @step {w['step']:<6} "
+                  f"({w['steps']} steps, value-fetch block "
+                  f"{1e3 * w['block_s']:.2f} ms){loss}")
+
+    total_stall = sum(t for _, _, t in rep["stall_histogram"])
+    print(f"\n== input-wait histogram (data_wait): {total_stall:.3f} s total ==")
+    for label, n, secs in rep["stall_histogram"]:
+        print(f"  {label:>8}  x{n:<6d} {secs:10.3f} s")
+    ps = rep["prefetch_stalls"]
+    print(f"  of which prefetch buffer-empty stalls: x{ps['count']} "
+          f"{ps['seconds']:.3f} s")
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("output_dir", help="trainer output dir (holds spans.jsonl)")
+    p.add_argument("--top", type=int, default=5,
+                   help="slowest logging windows to list")
+    p.add_argument("--json", action="store_true",
+                   help="emit the report as JSON instead of tables")
+    args = p.parse_args(argv)
+    rep = build_report(args.output_dir, top=args.top)
+    if args.json:
+        print(json.dumps(rep, indent=2))
+    else:
+        print_report(rep)
+
+
+if __name__ == "__main__":
+    main()
