@@ -1,4 +1,5 @@
 from llama_pipeline_parallel_tpu.ckpt.checkpoint import (  # noqa: F401
+    CheckpointCorruptError,
     CheckpointManager,
     find_resume_checkpoint,
 )

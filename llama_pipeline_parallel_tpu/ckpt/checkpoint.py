@@ -15,11 +15,18 @@ Design differences from the reference:
 - Params and optimizer state are separate Orbax items, so a module-only warm
   start from a FULL training checkpoint needs no monkey-patch — it simply
   doesn't open the optimizer item.
+- Integrity (docs/RESILIENCE.md): the commit records per-file sha256 digests
+  in meta.json; restores verify them first and QUARANTINE a corrupt
+  checkpoint to `checkpoint-N.corrupt` (latest_step() then falls back to the
+  previous complete one). meta/tag writes are atomic (tmp + os.replace) and
+  all storage I/O runs under the shared transient-retry policy
+  (utils/retry.py, LPT_RETRY_* knobs).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
 import re
@@ -33,13 +40,86 @@ from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
 from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
 from llama_pipeline_parallel_tpu.parallel import distributed as dist
 from llama_pipeline_parallel_tpu.parallel import pipeline as pl
-from llama_pipeline_parallel_tpu.utils import trace
+from llama_pipeline_parallel_tpu.utils import faults, retry, trace
 from llama_pipeline_parallel_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
 LATEST_TAG = "latest"  # tag-file name, as in the reference (convert2ckpt.py:76)
 _CKPT_RE = re.compile(r"^checkpoint-(\d+)$")
+QUARANTINE_SUFFIX = ".corrupt"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed integrity verification (or its meta.json is
+    unreadable). Deliberately NOT an OSError: the retry layer must never
+    re-try a deterministic corruption verdict — the caller falls back to
+    the previous complete checkpoint instead (docs/RESILIENCE.md)."""
+
+
+def _storage_policy() -> retry.RetryPolicy:
+    """The shared transient-storage retry policy (env-tunable, LPT_RETRY_*)."""
+    return retry.RetryPolicy.from_env()
+
+
+def _write_file_atomic(path: str, data: str) -> None:
+    """Crash-safe small-file write: tmp file + fsync + os.replace, under the
+    storage retry policy. A crash mid-write can never publish a truncated
+    file — readers see the old content or the new, never a torn one (the
+    seed's bare open/write here was exactly how a killed process produced a
+    meta.json that made `_is_complete` true but `load_meta` raise)."""
+
+    def write():
+        faults.fire("storage_write", tag=path)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+
+    retry.retry_call(write, policy=_storage_policy(),
+                     describe=f"write {os.path.basename(path)}")
+
+
+def _digests_enabled() -> bool:
+    return os.environ.get("LPT_CKPT_DIGESTS", "1") != "0"
+
+
+def _verify_default() -> bool:
+    return os.environ.get("LPT_CKPT_VERIFY", "1") != "0"
+
+
+def _file_digest(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _dir_digests(root: str) -> dict[str, str]:
+    """sha256 of every file under `root` (relative posix paths), meta.json
+    excluded — the digests live INSIDE meta.json, which is written after
+    this walk, so it can never hash itself."""
+    out: dict[str, str] = {}
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            if rel == "meta.json":
+                continue
+            out[rel] = retry.retry_call(
+                lambda full=full: _file_digest(full), policy=_storage_policy(),
+                describe=f"digest {rel}")
+    return out
 
 
 def _canonicalize_moments(tree: Any, manifest: StageManifest, to_canonical: bool) -> Any:
@@ -141,8 +221,66 @@ class CheckpointManager:
     def _is_complete(self, name: str) -> bool:
         # meta.json is written LAST (after the async array writes finish), so
         # its presence marks a durably complete checkpoint; an interrupted
-        # save leaves a dir that must be ignored, not resumed from.
-        return os.path.isfile(os.path.join(self.root, name, "meta.json"))
+        # save leaves a dir that must be ignored, not resumed from. Presence
+        # is not enough: a meta.json that exists but does not PARSE (torn
+        # write from a pre-atomic-writer crash, storage corruption) marks a
+        # checkpoint that would explode at restore — quarantine it now so
+        # latest_step() falls back instead.
+        meta = os.path.join(self.root, name, "meta.json")
+        if not os.path.isfile(meta):
+            return False
+
+        def read():
+            with open(meta) as f:
+                return f.read()
+
+        try:
+            raw = retry.retry_call(read, policy=_storage_policy(),
+                                   non_retryable=(FileNotFoundError,),
+                                   describe=f"read {name}/meta.json")
+        except FileNotFoundError:
+            return False  # quarantined/pruned underneath this scan
+        except OSError:
+            # a PERSISTENT read failure is a storage outage, not a
+            # corruption verdict: do NOT quarantine a possibly-healthy dir,
+            # and do NOT answer "incomplete" either — that would let
+            # latest_step() return None and a resume silently restart from
+            # step 0, overwriting real progress. Fail the query; the
+            # supervisor restarts the run once storage recovers.
+            logger.error("cannot read %s/meta.json after retries; refusing "
+                         "to classify the checkpoint during a storage outage",
+                         name)
+            raise
+        try:
+            json.loads(raw)
+            return True
+        except ValueError:
+            # the bytes WERE readable and do not parse: torn write from a
+            # pre-atomic-writer crash, or storage corruption
+            self._quarantine(name, "unparseable meta.json")
+            return False
+
+    def _quarantine(self, name: str, reason: str) -> str | None:
+        """Move checkpoint-N aside to checkpoint-N.corrupt so no reader
+        (latest_step, find_resume_checkpoint, prune) ever considers it
+        again. Rename, not delete: the bytes stay for a post-mortem.
+        Best-effort — a peer process racing to the same verdict wins the
+        rename and this one just logs."""
+        src = os.path.join(self.root, name)
+        dst = src + QUARANTINE_SUFFIX
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = f"{src}{QUARANTINE_SUFFIX}.{n}"
+        try:
+            os.rename(src, dst)
+        except OSError as e:
+            logger.warning("could not quarantine %s (%s): %r", name, reason, e)
+            return None
+        logger.error("quarantined %s -> %s (%s); resume will fall back to "
+                     "the previous complete checkpoint", name,
+                     os.path.basename(dst), reason)
+        return dst
 
     def latest_tag_value(self) -> str | None:
         """Raw contents of the `latest` tag file, if present."""
@@ -243,12 +381,11 @@ class CheckpointManager:
         # its own `ckpt_commit` span on the commit thread, visible in
         # spans.jsonl but excluded from the RunClock's wall-time buckets
         with trace.span("ckpt_save", step=step, blocking=blocking):
-            self._ckptr.save(os.path.join(path, "params"),
-                             pl.unstack_stages(params_stacked, manifest), force=True)
+            self._save_item(os.path.join(path, "params"),
+                            pl.unstack_stages(params_stacked, manifest))
             if opt_state is not None:
-                self._ckptr.save(os.path.join(path, "opt"),
-                                 _canonicalize_moments(opt_state, manifest, to_canonical=True),
-                                 force=True)
+                self._save_item(os.path.join(path, "opt"),
+                                _canonicalize_moments(opt_state, manifest, to_canonical=True))
 
             def commit():
                 self._commit(path, step, manifest, cfg,
@@ -289,14 +426,12 @@ class CheckpointManager:
         self.finalize()
         path = self.step_dir(step)
         with trace.span("ckpt_save", step=step, blocking=True, offload=True):
-            self._ckptr.save(os.path.join(path, "params"),
-                             pl.unstack_stages(host.masters_tree(), manifest),
-                             force=True)
+            self._save_item(os.path.join(path, "params"),
+                            pl.unstack_stages(host.masters_tree(), manifest))
             self._ckptr.wait_until_finished()
             for attr in ("m", "v"):
-                self._ckptr.save(os.path.join(path, f"opt_{attr}"),
-                                 pl.unstack_stages(host.moments_tree(attr), manifest),
-                                 force=True)
+                self._save_item(os.path.join(path, f"opt_{attr}"),
+                                pl.unstack_stages(host.moments_tree(attr), manifest))
                 self._ckptr.wait_until_finished()
             self._commit(path, step, manifest, cfg, has_optimizer_state=True,
                          opt_layout="offload_parts",
@@ -326,6 +461,10 @@ class CheckpointManager:
         key = (f"{zlib.crc32(self.root.encode()):08x}-{step}-{self._commit_seq}")
         self._ckptr.wait_until_finished()
         dist.host_barrier(f"ckpt-arrays-{key}")
+        # chaos hook: a `die` rule here kills the process AFTER the arrays
+        # are durable but BEFORE the completeness marker — the classic
+        # crash-mid-async-save window every resume path must survive
+        faults.fire("ckpt_commit", tag=path, step=step)
         if jax.process_index() == 0:
             meta = {
                 "step": step,
@@ -334,35 +473,156 @@ class CheckpointManager:
                 "format_version": 1,
                 **meta_extra,
             }
-            with open(os.path.join(path, "meta.json"), "w") as f:
-                json.dump(meta, f, indent=2)
-            with open(os.path.join(self.root, LATEST_TAG), "w") as f:
-                f.write(f"checkpoint-{step}")
+            if _digests_enabled():
+                # hashed AFTER every process's arrays landed (the barrier
+                # above), so the digests cover the final bytes of all shards
+                with trace.span("ckpt_digest", step=step):
+                    meta["integrity"] = {"algo": "sha256",
+                                         "files": _dir_digests(path)}
+            # atomic + retried: a crash between these two writes leaves a
+            # complete, verifiable checkpoint with a stale tag — which
+            # latest_step() already recovers from via the directory scan
+            _write_file_atomic(os.path.join(path, "meta.json"),
+                              json.dumps(meta, indent=2))
+            _write_file_atomic(os.path.join(self.root, LATEST_TAG),
+                              f"checkpoint-{step}")
         dist.host_barrier(f"ckpt-commit-{key}")
         logger.info("saved checkpoint-%d to %s", step, path)
+
+    def _save_item(self, item_path: str, tree: Any) -> None:
+        """One Orbax item write under the storage retry policy (a transient
+        I/O failure at write INITIATION retries; the async flush tail is
+        covered by wait_until_finished surfacing in _commit/finalize)."""
+
+        def save():
+            faults.fire("storage_write", tag=item_path)
+            self._ckptr.save(item_path, tree, force=True)
+
+        retry.retry_call(save, policy=_storage_policy(),
+                         describe=f"orbax save {os.path.basename(item_path)}")
+
+    def _restore_item(self, item_path: str, template: Any) -> Any:
+        """One Orbax item restore under the storage retry policy (restore is
+        synchronous and idempotent, so a blipped read simply re-runs)."""
+
+        def restore():
+            faults.fire("storage_write", tag=item_path)
+            return self._ckptr.restore(item_path, template)
+
+        try:
+            return retry.retry_call(
+                restore, policy=_storage_policy(),
+                non_retryable=(FileNotFoundError,),
+                describe=f"orbax restore {os.path.basename(item_path)}")
+        except FileNotFoundError as e:
+            # on a pod, a PEER process may quarantine the checkpoint while
+            # this one is mid-restore (its own verify passed first) — the dir
+            # vanishing out from under us is a corruption verdict to fall
+            # back from, not a fatal missing-file bug
+            step_dir = os.path.dirname(item_path)
+            if not os.path.isfile(os.path.join(step_dir, "meta.json")):
+                raise CheckpointCorruptError(
+                    f"{os.path.basename(step_dir)} disappeared mid-restore "
+                    f"(quarantined by a peer?): {e}") from e
+            raise
+
+    # -- integrity ---------------------------------------------------------
+
+    def verify(self, step: int) -> None:
+        """Recompute the per-file digests recorded at commit and compare.
+
+        Raises CheckpointCorruptError — after quarantining the directory —
+        on any mismatch or missing file, so a restore can never silently
+        consume a bit-flipped or truncated array item. Checkpoints written
+        before the integrity format (no `integrity` in meta.json) pass with
+        a log line: verification is best-effort there, not a lockout.
+
+        Multi-host cost note: every process verifies independently (N hosts
+        re-hash the same shared-storage files). That is convergent — if one
+        host quarantines first, the peers' hashing or restore sees the dir
+        vanish and raises the same CheckpointCorruptError, so everyone falls
+        back together — but it reads the checkpoint N times; on very large
+        checkpoints set LPT_CKPT_VERIFY=0 (or verify=False) and rely on the
+        commit-time digests plus an offline check."""
+        path = self.step_dir(step)
+        name = os.path.basename(path)
+        try:
+            meta = self.load_meta(step)
+        except FileNotFoundError as e:
+            # the dir (or its marker) vanished — quarantined by a peer, or
+            # never complete. Already invisible to every reader, so there is
+            # nothing to quarantine; just direct the caller to fall back.
+            raise CheckpointCorruptError(
+                f"{name}: meta.json missing: {e}") from e
+        except ValueError as e:
+            # readable bytes that do not parse: corruption, not an outage
+            self._quarantine(name, f"unparseable meta.json ({e!r})")
+            raise CheckpointCorruptError(
+                f"{name}: meta.json unparseable: {e}") from e
+        # any other OSError (persistent storage outage) propagates untouched:
+        # same do-not-quarantine-on-I/O-failure policy as _is_complete
+        integrity = meta.get("integrity")
+        if not integrity:
+            logger.info("%s has no integrity digests (pre-integrity format); "
+                        "skipping verification", name)
+            return
+        bad: list[str] = []
+        with trace.span("ckpt_verify", step=step):
+            for rel, want in integrity.get("files", {}).items():
+                full = os.path.join(path, rel.replace("/", os.sep))
+                if not os.path.isfile(full):
+                    bad.append(f"{rel}: missing")
+                    continue
+                got = retry.retry_call(
+                    lambda full=full: _file_digest(full),
+                    policy=_storage_policy(), describe=f"digest {rel}")
+                if got != want:
+                    bad.append(f"{rel}: sha256 {got[:12]}... != recorded "
+                               f"{want[:12]}...")
+        if bad:
+            self._quarantine(name, f"{len(bad)} corrupt item(s)")
+            raise CheckpointCorruptError(
+                f"{name} failed integrity verification: " + "; ".join(bad))
+        logger.info("%s verified (%d files)", name, len(integrity.get("files", {})))
 
     # -- load -------------------------------------------------------------
 
     def load_meta(self, step: int) -> dict:
         self.finalize()
-        with open(os.path.join(self.step_dir(step), "meta.json")) as f:
-            return json.load(f)
+        meta_path = os.path.join(self.step_dir(step), "meta.json")
+
+        def read():
+            with open(meta_path) as f:
+                return json.load(f)
+
+        return retry.retry_call(read, policy=_storage_policy(),
+                                non_retryable=(FileNotFoundError,),
+                                describe=f"read {meta_path}")
 
     def load_params(self, step: int, params_template_stacked: dict,
-                    manifest: StageManifest) -> dict:
+                    manifest: StageManifest, verify: bool | None = None) -> dict:
         """Module-only warm start (reference `load_module_only=True`,
         trainer_base_ds_mp.py:284): restores params into the CURRENT
-        topology's stacked layout, regardless of the PP degree at save time."""
+        topology's stacked layout, regardless of the PP degree at save time.
+
+        `verify` (default: on, unless LPT_CKPT_VERIFY=0): check the commit's
+        recorded digests first; corruption quarantines the checkpoint and
+        raises CheckpointCorruptError instead of restoring garbage."""
+        if _verify_default() if verify is None else verify:
+            self.verify(step)
         with trace.span("ckpt_restore", step=step, item="params"):
             canonical = pl.unstack_stages(params_template_stacked, manifest)
-            restored = self._ckptr.restore(
+            restored = self._restore_item(
                 os.path.join(self.step_dir(step), "params"), _abstract(canonical))
             return pl.stack_stages(restored, manifest)
 
     def load_offload_moments(self, step: int, params_template_stacked: dict,
-                             manifest: StageManifest) -> tuple[dict, dict, int]:
+                             manifest: StageManifest,
+                             verify: bool | None = None) -> tuple[dict, dict, int]:
         """Restore the offload layout's moment trees (m, v, step_count),
         one item at a time (same HBM bounding as save_offload)."""
+        if _verify_default() if verify is None else verify:
+            self.verify(step)
         meta = self.load_meta(step)
         if meta.get("opt_layout") != "offload_parts":
             raise ValueError(
@@ -372,15 +632,20 @@ class CheckpointManager:
         out = []
         with trace.span("ckpt_restore", step=step, item="offload_moments"):
             for attr in ("m", "v"):
-                restored = self._ckptr.restore(
+                restored = self._restore_item(
                     os.path.join(self.step_dir(step), f"opt_{attr}"),
                     _abstract(canonical))
                 out.append(pl.stack_stages(restored, manifest))
         return out[0], out[1], int(meta["opt_step_count"])
 
     def load(self, step: int, params_template_stacked: dict, opt_template: Any,
-             manifest: StageManifest) -> tuple[dict, Any, int]:
-        """Full-state resume (reference trainer_base_ds_mp.py:297-299)."""
+             manifest: StageManifest, verify: bool | None = None
+             ) -> tuple[dict, Any, int]:
+        """Full-state resume (reference trainer_base_ds_mp.py:297-299).
+        One `verify(step)` covers every item in the dir — the params load
+        below skips its own pass so the files are hashed once, not twice."""
+        if _verify_default() if verify is None else verify:
+            self.verify(step)
         meta = self.load_meta(step)
         if not meta.get("has_optimizer_state"):
             raise ValueError(
@@ -392,10 +657,11 @@ class CheckpointManager:
                 f"optimizer (opt_layout=offload_parts); resume it with "
                 f"optimizer_offload: true, or warm-start module-only via "
                 f"model_name_or_path")
-        params = self.load_params(step, params_template_stacked, manifest)
+        params = self.load_params(step, params_template_stacked, manifest,
+                                  verify=False)
         with trace.span("ckpt_restore", step=step, item="opt"):
             opt_canonical = _canonicalize_moments(opt_template, manifest, to_canonical=True)
-            restored_opt = self._ckptr.restore(
+            restored_opt = self._restore_item(
                 os.path.join(self.step_dir(step), "opt"), _abstract(opt_canonical))
             opt_state = _canonicalize_moments(restored_opt, manifest, to_canonical=False)
         return params, opt_state, int(meta["step"])

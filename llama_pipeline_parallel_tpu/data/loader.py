@@ -22,6 +22,14 @@ from typing import Any, Callable, Iterator, Sequence
 
 import numpy as np
 
+from llama_pipeline_parallel_tpu.utils import faults, retry
+
+
+class CorruptRecordError(OSError):
+    """A dataset read produced an unusable record (None, or a fault-injected
+    corruption). OSError subclass => retried like any transient source read:
+    a flaky storage-backed dataset re-fetches before killing training."""
+
 
 @dataclasses.dataclass
 class ShardedSampler:
@@ -94,6 +102,10 @@ class DataLoader:
         if not (0 <= first and first + count <= self.dp_size):
             raise ValueError(f"dp_range {self.dp_range} outside dp_size {self.dp_size}")
         self._local_dp = range(first, first + count)
+        # resolved once per loader, not per record: the env-tunable policy
+        # read must not cost three os.environ lookups on every read of the
+        # prefetch producer's hot path
+        self._retry_policy = retry.RetryPolicy.from_env()
         self._samplers = [
             ShardedSampler(len(self.dataset), self.dp_size, rank=d,
                            shuffle=self.shuffle, seed=self.seed)
@@ -108,6 +120,24 @@ class DataLoader:
         """Batches per epoch."""
         return self._samplers[0].num_samples_per_replica // self.per_replica_batch
 
+    def _read_record(self, index: int) -> Any:
+        """One dataset read under the shared transient-retry policy
+        (docs/RESILIENCE.md): a storage blip or fault-injected failure on the
+        prefetch producer re-fetches with backoff instead of propagating
+        through PrefetchIterator and killing the run. IndexError stays fatal
+        (a sampler bug, not a blip)."""
+
+        def read():
+            action = faults.fire("data_read", tag=str(index))
+            row = self.dataset[int(index)]
+            if action == "corrupt" or row is None:
+                raise CorruptRecordError(f"dataset[{index}] returned a "
+                                         f"corrupt/empty record")
+            return row
+
+        return retry.retry_call(read, policy=self._retry_policy,
+                                describe=f"dataset[{index}]")
+
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
         per_replica = [s.indices() for s in self._samplers]
         for b in range(len(self)):
@@ -115,7 +145,7 @@ class DataLoader:
             for local_idx, _ in enumerate(self._local_dp):
                 sl = per_replica[local_idx][
                     b * self.per_replica_batch:(b + 1) * self.per_replica_batch]
-                rows.extend(self.dataset[int(i)] for i in sl)
+                rows.extend(self._read_record(int(i)) for i in sl)
             yield self.collate_fn(rows)
 
 

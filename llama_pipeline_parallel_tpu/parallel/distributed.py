@@ -12,6 +12,7 @@ all-process sync around filesystem phases (checkpoint commit).
 from __future__ import annotations
 
 import os
+import time
 from typing import Mapping
 
 import jax
@@ -19,6 +20,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from llama_pipeline_parallel_tpu.parallel.mesh import AXIS_DP
+from llama_pipeline_parallel_tpu.utils import faults, retry
 from llama_pipeline_parallel_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -100,17 +102,106 @@ def barrier(tag: str = "sync") -> None:
     multihost_utils.sync_global_devices(tag)
 
 
-def host_barrier(tag: str, timeout_s: int = 1800) -> None:
+_DEFAULT_BARRIER_TIMEOUT_S = 1800.0
+_barrier_timeout_config: float | None = None  # set_barrier_timeout (run config)
+
+
+class BarrierTimeoutError(RuntimeError):
+    """The host barrier's wait deadline expired: a peer is dead or hung.
+    Never retried — peers that already passed the barrier will not re-enter
+    it, so a fresh attempt can only time out again."""
+
+
+class TransientBarrierError(RuntimeError):
+    """The barrier RPC itself failed (connection blip, coordination-service
+    hiccup) before the deadline — retried under the shared policy."""
+
+
+def set_barrier_timeout(timeout_s: float | None) -> None:
+    """Install the run config's `barrier_timeout_s` as the process default
+    (None clears it). Resolution order at each wait: explicit `timeout_s`
+    arg > LPT_BARRIER_TIMEOUT_S env > this config value > 1800s."""
+    global _barrier_timeout_config
+    _barrier_timeout_config = None if timeout_s is None else float(timeout_s)
+
+
+def barrier_timeout_s() -> float:
+    env = os.environ.get("LPT_BARRIER_TIMEOUT_S")
+    if env:
+        return float(env)
+    if _barrier_timeout_config is not None:
+        return _barrier_timeout_config
+    return _DEFAULT_BARRIER_TIMEOUT_S
+
+
+def _barrier_sync_fn():
+    """Indirection point (tests monkeypatch this to simulate RPC failures
+    without a real pod)."""
+    from orbax.checkpoint import multihost as ocp_multihost
+
+    return ocp_multihost.get_barrier_sync_fn()
+
+
+def _is_timeout_error(e: BaseException) -> bool:
+    msg = str(e).lower()
+    return any(t in msg for t in ("deadline", "timed out", "timeout"))
+
+
+def host_barrier(tag: str, timeout_s: float | None = None) -> None:
     """Coordination-service barrier: a plain RPC against the jax distributed
     client, NO device collective — safe from background threads (the async
     checkpoint commit), where `barrier()`'s `sync_global_devices` would race
     the main thread's training collectives and deadlock the pod. `tag` must
-    be unique per wait (the service rejects re-used barrier keys)."""
-    if jax.process_count() == 1:
-        return
-    from orbax.checkpoint import multihost as ocp_multihost
+    be unique per wait (the service rejects re-used barrier keys).
 
-    ocp_multihost.get_barrier_sync_fn()(key=tag, timeout_ms=timeout_s * 1000)
+    Failure semantics (docs/RESILIENCE.md): a deadline expiry raises
+    BarrierTimeoutError naming the tag, elapsed time, and configured timeout
+    (instead of the seed's opaque Orbax error) and is never retried — the
+    peers that already passed will not re-enter. A transient RPC failure
+    retries under the shared policy, each attempt on a FRESH key
+    (`tag~retryN`, the service rejects re-used keys). Retried waits
+    rendezvous only when the failure was SYMMETRIC (a coordination-service
+    hiccup every process observed — they all derive the same attempt
+    numbering); a one-process blip leaves peers waiting on the original key
+    until its deadline either way (they cannot observe this process's
+    failure), so retries are bounded at LPT_BARRIER_RETRIES (default 1) to
+    cap the extra wall-clock the failing process can add on top of that
+    unavoidable peer timeout before the supervisor-driven restart."""
+    timeout = float(timeout_s) if timeout_s is not None else barrier_timeout_s()
+    t0 = time.monotonic()
+    state = {"attempt": 0}
+
+    def wait_once():
+        state["attempt"] += 1
+        # the fault site lives INSIDE the retried wait (and before the
+        # single-process early-out), so a plan's op=error barrier rule
+        # exercises the classification + retry machinery even in
+        # single-process chaos tests; op=stall delays each attempt
+        try:
+            faults.fire("barrier", tag=tag)
+        except faults.InjectedFault as e:
+            raise TransientBarrierError(
+                f"host barrier {tag!r} failed after "
+                f"{time.monotonic() - t0:.1f}s (injected, attempt "
+                f"{state['attempt']}): {e}") from e
+        if jax.process_count() == 1:
+            return
+        key = tag if state["attempt"] == 1 else f"{tag}~retry{state['attempt'] - 1}"
+        try:
+            _barrier_sync_fn()(key=key, timeout_ms=int(timeout * 1000))
+        except Exception as e:
+            elapsed = time.monotonic() - t0
+            msg = (f"host barrier {tag!r} failed after {elapsed:.1f}s "
+                   f"(timeout_s={timeout:.0f}, attempt {state['attempt']}): {e}")
+            if _is_timeout_error(e):
+                raise BarrierTimeoutError(msg) from e
+            raise TransientBarrierError(msg) from e
+
+    retries = int(os.environ.get("LPT_BARRIER_RETRIES", "1"))
+    retry.retry_call(wait_once, retryable=(TransientBarrierError,),
+                     policy=retry.RetryPolicy.from_env(
+                         max_attempts=max(retries, 0) + 1),
+                     describe=f"host_barrier {tag!r}")
 
 
 def form_global_batch(mesh: Mesh, host_batch: Mapping[str, np.ndarray]) -> dict:

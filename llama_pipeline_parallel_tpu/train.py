@@ -25,7 +25,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
+from llama_pipeline_parallel_tpu.ckpt.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointManager,
+)
 from llama_pipeline_parallel_tpu.data.collator import (
     CausalLMCollator,
     PackedCausalLMCollator,
@@ -48,9 +51,10 @@ from llama_pipeline_parallel_tpu.parallel.distributed import (
     form_global_batch,
     host_dp_shard,
     initialize_distributed,
+    set_barrier_timeout,
 )
 from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
-from llama_pipeline_parallel_tpu.utils import trace
+from llama_pipeline_parallel_tpu.utils import faults, trace
 from llama_pipeline_parallel_tpu.utils.config import instantiate
 from llama_pipeline_parallel_tpu.utils.logging import get_logger
 from llama_pipeline_parallel_tpu.utils.metrics import (
@@ -450,6 +454,14 @@ def _reset_compilation_cache() -> None:
 def run_training(cfg: dict) -> dict:
     """The full training run; returns a summary dict for programmatic callers."""
     _install_preemption_handlers()
+    # Fault-tolerance wiring (docs/RESILIENCE.md): the env plan wins over the
+    # config node — the supervisor drives chaos runs through LPT_FAULT_PLAN
+    # and must be able to override whatever the config ships.
+    if os.environ.get(faults.ENV_PLAN):
+        faults.configure_from_env()
+    else:
+        faults.configure(cfg.get("fault_plan"))
+    set_barrier_timeout(cfg.get("barrier_timeout_s"))
     # jax settings are process-global: save/restore around the run so a later
     # run_training in the same process doesn't inherit this config's cache
     prev_cache = jax.config.jax_compilation_cache_dir
@@ -469,6 +481,8 @@ def run_training(cfg: dict) -> dict:
             jax.config.update("jax_compilation_cache_dir", prev_cache)
             _reset_compilation_cache()  # later runs must not inherit the dir
         trace.configure(None)  # close this run's spans.jsonl writer
+        set_barrier_timeout(None)  # later runs must not inherit the timeout
+        faults.configure(None)  # ...or this run's fault plan
         _release_preemption_handlers()
 
 
@@ -541,15 +555,17 @@ def _run_training(cfg: dict) -> dict:
                          "sharded moments)")
 
     resume_step = 0
-    resume = mgr.latest_step() if cfg.get("resume", True) else None
     # Donate the init output into the train state (no second fp32 copy) and
     # keep only abstract shapes as the structure template from here on.
     template_struct = jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
                                    stacked_template)
     state = ts.init_train_state(stacked_template, tx, mesh, donate_params=True)
     stacked_template = template_struct
-    if resume is not None:
-        p, o, resume_step = mgr.load(resume, state.params, state.opt_state, manifest)
+    restored = (_restore_with_fallback(
+        mgr, lambda s: mgr.load(s, state.params, state.opt_state, manifest))
+        if cfg.get("resume", True) else None)
+    if restored is not None:
+        p, o, resume_step = restored
         shard_of = lambda tmpl: jax.tree.map(lambda x: x.sharding, tmpl)
         state = ts.TrainState(
             step=jnp.asarray(resume_step, jnp.int32),
@@ -623,6 +639,33 @@ def _run_training(cfg: dict) -> dict:
     mgr.finalize()  # surface any async-commit failure on the clean path
     return _summarize(final_loss, preempted_at, end_step, steps_per_epoch,
                       output_dir)
+
+
+def _restore_with_fallback(mgr: CheckpointManager, restore_fn) -> Any | None:
+    """Resume restore with automatic fallback (docs/RESILIENCE.md): when the
+    newest checkpoint fails integrity verification, `verify` quarantines it
+    to checkpoint-N.corrupt, `latest_step()` then resolves to the previous
+    complete one, and the restore simply re-runs — until a checkpoint
+    verifies or none remain (fresh start). Only CheckpointCorruptError
+    falls back; layout/compat errors (ValueError) stay fatal — they mean a
+    misconfigured run, and silently training from an older checkpoint would
+    hide that."""
+    prev: int | None = None
+    while True:
+        step = mgr.latest_step()
+        if step is None:
+            return None
+        if step == prev:
+            # quarantine could not move the dir (permissions?) — re-raising
+            # beats spinning on the same corrupt checkpoint forever
+            raise CheckpointCorruptError(
+                f"checkpoint-{step} is corrupt and could not be quarantined")
+        try:
+            return restore_fn(step)
+        except CheckpointCorruptError as e:
+            logger.error("resume blocked by corrupt checkpoint-%d (%s); "
+                         "falling back", step, e)
+            prev = step
 
 
 def _summarize(final_loss, preempted_at, end_step, steps_per_epoch,
@@ -808,6 +851,9 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
 
     try:
         for step in range(resume_step, end_step):
+            # chaos hook: a `die`/`stall` rule at a chosen step simulates
+            # preemption or a hung pod at an exact, reproducible point
+            faults.fire("step", step=step)
             # The sync point must be polled EVERY step with the loop's step id
             # (the protocol computes max-step+1 as the one safe stop step for
             # the whole pod); it returns True on every process at that same
@@ -992,8 +1038,8 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
     stacked_template = host.abstract_tree()
 
     resume_step = 0
-    resume = mgr.latest_step() if cfg.get("resume", True) else None
-    if resume is not None:
+
+    def _restore_offload(resume: int) -> int:
         meta = mgr.load_meta(resume)
         if not meta.get("has_optimizer_state"):
             raise ValueError(
@@ -1015,11 +1061,18 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
         # reshape), Orbax restores each host's shards locally, and _scatter
         # reads only addressable shards — executed across real processes by
         # tests/test_multiprocess.py::test_offload_trainer_two_process_resume.
+        # load_params runs the integrity pass over the WHOLE dir, so the
+        # moments restore below skips its own (verify=False — hash once).
         host.load_masters(mgr.load_params(resume, stacked_template, manifest))
         m, v, step_count = mgr.load_offload_moments(resume, stacked_template,
-                                                    manifest)
+                                                    manifest, verify=False)
         host.load_state_dict({"m": m, "v": v, "step_count": step_count})
-        resume_step = resume
+        return resume
+
+    restored = (_restore_with_fallback(mgr, _restore_offload)
+                if cfg.get("resume", True) else None)
+    if restored is not None:
+        resume_step = restored
         logger.info("resumed offloaded state from checkpoint-%d", resume_step)
     elif cfg.get("model_name_or_path"):
         warm = CheckpointManager(cfg["model_name_or_path"])

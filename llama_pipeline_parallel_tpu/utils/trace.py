@@ -213,11 +213,20 @@ class RunClock:
         self._pre = already_elapsed
         self.buckets: dict[str, float] = {b: 0.0 for b in BUCKETS if b != "untracked"}
         self._prior_elapsed = 0.0
-        if prior:
-            for k, v in prior.get("buckets", {}).items():
+        # a half-written prior snapshot (crashed incarnation) degrades to a
+        # fresh clock — resilience must not depend on the dead run's tidiness
+        if prior and isinstance(prior, dict):
+            buckets = prior.get("buckets")
+            for k, v in (buckets.items() if isinstance(buckets, dict) else ()):
                 if k != "untracked":
-                    self.buckets[k] = self.buckets.get(k, 0.0) + float(v)
-            self._prior_elapsed = float(prior.get("elapsed", 0.0))
+                    try:
+                        self.buckets[k] = self.buckets.get(k, 0.0) + float(v)
+                    except (TypeError, ValueError):
+                        pass
+            try:
+                self._prior_elapsed = float(prior.get("elapsed", 0.0))
+            except (TypeError, ValueError):
+                pass
 
     def add(self, bucket: str, seconds: float) -> None:
         self.buckets[bucket] = self.buckets.get(bucket, 0.0) + seconds
@@ -357,9 +366,12 @@ class Heartbeat:
 
 
 def load_health(output_dir: str) -> dict | None:
-    """Previous incarnation's health.json (RunClock `prior=` seed), or None."""
+    """Previous incarnation's health.json (RunClock `prior=` seed), or None
+    when absent, torn, or not a JSON object — a restart after a crash must
+    never die on the dead incarnation's last write."""
     try:
         with open(os.path.join(output_dir, "health.json")) as f:
-            return json.load(f)
+            health = json.load(f)
     except (OSError, ValueError):
         return None
+    return health if isinstance(health, dict) else None

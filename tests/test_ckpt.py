@@ -183,6 +183,39 @@ def test_latest_tag_and_resume_detection(tmp_path, cfg, devices):
     assert find_resume_checkpoint(str(tmp_path))[0] == 5
 
 
+def test_resume_edge_cases_tag_meta_and_quarantine(tmp_path, cfg, devices):
+    """Resume-path edge cases (docs/RESILIENCE.md): a corrupt/stale `latest`
+    tag falls back to the directory scan; a checkpoint-N dir with no
+    meta.json is invisible to every reader; find_resume_checkpoint skips a
+    quarantined checkpoint."""
+    manifest = StageManifest.for_config(cfg, 1)
+    stacked = pl.stack_stages(llama.init_params(jax.random.PRNGKey(0), cfg),
+                              manifest)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(2, stacked, manifest, cfg)
+    mgr.save(5, stacked, manifest, cfg)
+
+    # tag holding garbage (not even a checkpoint-N name)
+    with open(tmp_path / "latest", "w") as f:
+        f.write("!!torn write garbage")
+    assert mgr.latest_step() == 5
+
+    # tag pointing at a checkpoint that never completed (dir, no meta.json)
+    os.makedirs(mgr.step_dir(9))
+    with open(tmp_path / "latest", "w") as f:
+        f.write("checkpoint-9")
+    assert mgr.latest_step() == 5
+    assert mgr.list_steps(complete_only=True) == [2, 5]
+    assert not mgr.is_complete(9)
+
+    # quarantined newest checkpoint: resume detection falls back past it
+    os.rename(mgr.step_dir(5), mgr.step_dir(5) + ".corrupt")
+    with open(tmp_path / "latest", "w") as f:
+        f.write("checkpoint-5")
+    step, path = find_resume_checkpoint(str(tmp_path))
+    assert step == 2 and path.endswith("checkpoint-2")
+
+
 @pytest.mark.slow
 def test_hf_export_round_trip(tmp_path, cfg, devices):
     """native ckpt -> HF (tools/export_hf) -> logits parity with our forward."""

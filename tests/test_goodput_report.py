@@ -104,3 +104,74 @@ def test_cli_smoke_prints_tables(run_dir, capsys):
 def test_empty_dir_fails_cleanly(tmp_path):
     with pytest.raises(SystemExit, match="no spans"):
         goodput_report.build_report(str(tmp_path))
+
+
+@pytest.mark.parametrize("payload", [
+    None,                     # missing file
+    '{"last_step": 12',       # torn mid-write
+    "[1, 2, 3]",              # valid JSON, wrong shape
+])
+def test_report_degrades_on_bad_health(run_dir, capsys, payload):
+    """A crashed run's dir is exactly where this tool gets pointed: a
+    missing or partially-written health.json degrades the report (status
+    field + None cumulative goodput) instead of tracebacking."""
+    health = run_dir / "health.json"
+    if payload is None:
+        health.unlink()
+    else:
+        health.write_text(payload)
+    rep = goodput_report.build_report(str(run_dir))
+    assert rep["cumulative_goodput"] is None
+    assert rep["health_status"] in ("missing", "corrupt")
+    goodput_report.print_report(rep)  # must not raise
+    out = capsys.readouterr().out
+    assert "degraded" in out
+
+
+def test_report_survives_garbage_health_values(run_dir, capsys):
+    """Parseable dict, unusable values: the fields degrade to None and the
+    printer still renders."""
+    (run_dir / "health.json").write_text(
+        '{"goodput": "NaNish", "last_step": 3}')
+    rep = goodput_report.build_report(str(run_dir))
+    assert rep["health_status"] == "ok"
+    assert rep["cumulative_goodput"] is None and rep["last_step"] == 3
+    goodput_report.print_report(rep)  # must not raise
+
+
+def test_incarnation_ledger_summary(run_dir, capsys):
+    """The supervisor's incarnations.jsonl folds into the report: restart
+    count, crash/hang split, and the wall seconds lost to dead incarnations."""
+    rows = [
+        {"incarnation": 0, "outcome": "crash", "duration_s": 30.0, "exit_code": -9},
+        {"incarnation": 1, "outcome": "hang", "duration_s": 20.5, "exit_code": -15},
+        {"incarnation": 2, "outcome": "clean", "duration_s": 50.0, "exit_code": 0},
+    ]
+    write_jsonl(run_dir / "incarnations.jsonl", rows)
+    rep = goodput_report.build_report(str(run_dir))
+    inc = rep["incarnations"]
+    assert inc == {"incarnations": 3, "restarts": 2, "crashes": 1, "hangs": 1,
+                   "lost_seconds": pytest.approx(50.5), "last_outcome": "clean"}
+    goodput_report.print_report(rep)
+    out = capsys.readouterr().out
+    assert "incarnations (supervisor ledger)" in out and "2 restart(s)" in out
+
+
+def test_torn_ledger_line_is_skipped(run_dir, capsys):
+    """The supervisor itself can be preempted mid-append: a truncated last
+    ledger line (or garbage duration) degrades instead of tracebacking."""
+    with open(run_dir / "incarnations.jsonl", "w") as f:
+        f.write(json.dumps({"incarnation": 0, "outcome": "crash",
+                            "duration_s": "garbage"}) + "\n")
+        f.write('{"incarnation": 1, "outco')  # torn mid-write
+    rep = goodput_report.build_report(str(run_dir))
+    assert rep["incarnations"]["incarnations"] == 1
+    assert rep["incarnations"]["lost_seconds"] == 0.0
+    goodput_report.print_report(rep)  # must not raise
+
+
+def test_no_ledger_no_section(run_dir, capsys):
+    rep = goodput_report.build_report(str(run_dir))
+    assert rep["incarnations"] is None
+    goodput_report.print_report(rep)
+    assert "supervisor ledger" not in capsys.readouterr().out

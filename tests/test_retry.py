@@ -1,0 +1,94 @@
+"""Retry policy math and retry_call semantics (utils/retry.py)."""
+
+import random
+
+import pytest
+
+from llama_pipeline_parallel_tpu.utils import retry
+
+
+def fast_policy(**kw):
+    defaults = dict(max_attempts=3, base_delay_s=0.001, max_delay_s=0.01,
+                    jitter=0.0)
+    defaults.update(kw)
+    return retry.RetryPolicy(**defaults)
+
+
+def test_backoff_is_exponential_and_capped():
+    pol = retry.RetryPolicy(base_delay_s=1.0, max_delay_s=4.0, multiplier=2.0,
+                            jitter=0.0)
+    rng = random.Random(0)
+    assert [pol.delay_s(a, rng) for a in (1, 2, 3, 4, 5)] == \
+        [1.0, 2.0, 4.0, 4.0, 4.0]
+
+
+def test_jitter_bounds_are_respected_and_seeded():
+    pol = retry.RetryPolicy(base_delay_s=1.0, jitter=0.25)
+    delays = [pol.delay_s(1, random.Random(7)) for _ in range(5)]
+    assert all(0.75 <= d <= 1.25 for d in delays)
+    # same seed -> same draw (determinism for chaos tests)
+    assert len(set(delays)) == 1
+
+
+def test_invalid_policy_rejected():
+    with pytest.raises(ValueError, match="max_attempts"):
+        retry.RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError, match="jitter"):
+        retry.RetryPolicy(jitter=1.0)
+
+
+def test_from_env_overrides(monkeypatch):
+    monkeypatch.setenv("LPT_RETRY_MAX_ATTEMPTS", "7")
+    monkeypatch.setenv("LPT_RETRY_BASE_DELAY_S", "0.125")
+    pol = retry.RetryPolicy.from_env()
+    assert pol.max_attempts == 7 and pol.base_delay_s == 0.125
+    # explicit kwargs beat env
+    assert retry.RetryPolicy.from_env(max_attempts=2).max_attempts == 2
+
+
+def test_transient_failure_then_success():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("blip")
+        return "ok"
+
+    retried = []
+    assert retry.retry_call(flaky, policy=fast_policy(),
+                            on_retry=lambda a, e: retried.append(a)) == "ok"
+    assert calls["n"] == 3 and retried == [1, 2]
+
+
+def test_budget_exhaustion_reraises_last_error():
+    def always_fails():
+        raise OSError("still down")
+
+    with pytest.raises(OSError, match="still down"):
+        retry.retry_call(always_fails, policy=fast_policy(max_attempts=2))
+
+
+def test_non_retryable_types_propagate_immediately():
+    calls = {"n": 0}
+
+    def bug():
+        calls["n"] += 1
+        raise ValueError("a bug, not a blip")
+
+    with pytest.raises(ValueError):
+        retry.retry_call(bug, policy=fast_policy())
+    assert calls["n"] == 1  # no retries burned on a deterministic failure
+
+
+def test_non_retryable_carve_out_of_retryable_base():
+    calls = {"n": 0}
+
+    def missing():
+        calls["n"] += 1
+        raise FileNotFoundError("gone")
+
+    with pytest.raises(FileNotFoundError):
+        retry.retry_call(missing, policy=fast_policy(),
+                         non_retryable=(FileNotFoundError,))
+    assert calls["n"] == 1

@@ -1,0 +1,123 @@
+"""tools/supervisor.py: restart-on-crash, hang detection, crash-loop abort,
+and the incarnations ledger — driven with fake millisecond-scale children."""
+
+import json
+import os
+import sys
+
+import pytest
+
+import supervisor  # tools/ on sys.path via conftest
+from supervisor import Supervisor, SupervisorConfig
+
+
+def fast_cfg(out, **kw):
+    defaults = dict(output_dir=str(out), max_restarts=2, hang_timeout_s=2.0,
+                    grace_s=1.0, crash_loop_threshold=3,
+                    crash_loop_window_s=0.0, poll_s=0.05)
+    defaults.update(kw)
+    return SupervisorConfig(**defaults)
+
+
+def py(script):
+    return [sys.executable, "-c", script]
+
+
+def ledger(out):
+    with open(os.path.join(str(out), supervisor.LEDGER_NAME)) as f:
+        return [json.loads(l) for l in f]
+
+
+def test_clean_child_single_incarnation(tmp_path):
+    rc = Supervisor(py("pass"), fast_cfg(tmp_path)).run()
+    assert rc == 0
+    rows = ledger(tmp_path)
+    assert len(rows) == 1
+    assert rows[0]["incarnation"] == 0 and rows[0]["outcome"] == "clean"
+    assert rows[0]["exit_code"] == 0
+
+
+def test_crash_restarts_until_clean(tmp_path):
+    """First incarnation crashes, second completes: the supervised-restart
+    happy path. The marker file stands in for 'a checkpoint now exists'."""
+    marker = tmp_path / "crashed.once"
+    script = (f"import os, sys\n"
+              f"m = {str(marker)!r}\n"
+              f"if not os.path.exists(m):\n"
+              f"    open(m, 'w').close(); sys.exit(17)\n")
+    rc = Supervisor(py(script), fast_cfg(tmp_path)).run()
+    assert rc == 0
+    outcomes = [(r["incarnation"], r["outcome"], r["exit_code"])
+                for r in ledger(tmp_path)]
+    assert outcomes == [(0, "crash", 17), (1, "clean", 0)]
+
+
+def test_restart_budget_exhausted(tmp_path):
+    rc = Supervisor(py("import sys; sys.exit(1)"),
+                    fast_cfg(tmp_path, max_restarts=1,
+                             crash_loop_threshold=99)).run()
+    assert rc == 2
+    assert [r["outcome"] for r in ledger(tmp_path)] == ["crash", "crash"]
+
+
+def test_crash_loop_aborts_before_budget(tmp_path):
+    rc = Supervisor(py("import sys; sys.exit(1)"),
+                    fast_cfg(tmp_path, max_restarts=50,
+                             crash_loop_threshold=2,
+                             crash_loop_window_s=100.0)).run()
+    assert rc == 3
+    assert len(ledger(tmp_path)) == 2  # gave up after 2 fast failures
+
+
+def test_hang_detection_kills_and_restarts(tmp_path):
+    """A child whose heartbeat goes stale is SIGTERMed (grace) and counted
+    as a hang; with every incarnation hanging, the budget drains to rc 2."""
+    health = os.path.join(str(tmp_path), "health.json")
+    script = (f"import json, time\n"
+              f"json.dump({{'time': time.time(), 'last_step': 3}}, "
+              f"open({health!r}, 'w'))\n"
+              f"time.sleep(60)\n")
+    rc = Supervisor(py(script),
+                    fast_cfg(tmp_path, max_restarts=1, hang_timeout_s=1.0,
+                             grace_s=1.0, crash_loop_threshold=99)).run()
+    assert rc == 2
+    rows = ledger(tmp_path)
+    assert [r["outcome"] for r in rows] == ["hang", "hang"]
+    assert all(r["exit_code"] != 0 for r in rows)  # died by signal
+    assert rows[0]["last_step"] == 3  # health context lands in the ledger
+
+
+def test_stale_health_from_previous_incarnation_ignored(tmp_path):
+    """A health.json left by a DEAD incarnation must not vouch for a new
+    child that never wrote one — but liveness falls back to the launch time,
+    so a fast clean child is still fine."""
+    with open(os.path.join(str(tmp_path), "health.json"), "w") as f:
+        json.dump({"time": 1.0}, f)  # ancient
+    rc = Supervisor(py("pass"), fast_cfg(tmp_path)).run()
+    assert rc == 0
+
+
+def test_read_health_degrades_on_garbage(tmp_path):
+    assert supervisor.read_health(str(tmp_path)) is None  # missing
+    p = os.path.join(str(tmp_path), "health.json")
+    with open(p, "w") as f:
+        f.write('{"time": 12')  # torn
+    assert supervisor.read_health(str(tmp_path)) is None
+    with open(p, "w") as f:
+        f.write("[1, 2]")  # valid JSON, wrong shape
+    assert supervisor.read_health(str(tmp_path)) is None
+    with open(p, "w") as f:
+        json.dump({"time": 5.0}, f)
+    assert supervisor.read_health(str(tmp_path)) == {"time": 5.0}
+
+
+def test_cli_requires_command(tmp_path, capsys):
+    with pytest.raises(SystemExit):
+        supervisor.main(["--output-dir", str(tmp_path)])
+
+
+def test_cli_runs_command_after_separator(tmp_path):
+    rc = supervisor.main(["--output-dir", str(tmp_path), "--poll-s", "0.05",
+                          "--"] + py("pass"))
+    assert rc == 0
+    assert ledger(tmp_path)[0]["outcome"] == "clean"

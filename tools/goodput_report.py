@@ -25,14 +25,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def load_jsonl(path: str) -> list[dict]:
+    """Parse a jsonl stream, skipping torn lines: a crashed writer (the
+    whole reason this tool exists) can leave a truncated tail in any of the
+    run artifacts, and the report must degrade, not traceback."""
     if not os.path.exists(path):
         return []
-    out = []
+    out, skipped = [], 0
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 out.append(json.loads(line))
+            except ValueError:
+                skipped += 1
+    if skipped:
+        print(f"warning: skipped {skipped} unparseable line(s) in {path} "
+              f"(torn write from a crashed run?)", file=sys.stderr)
     return out
 
 
@@ -115,15 +125,56 @@ def stall_histogram(spans: list[dict], name: str = "data_wait"
     return [(label, n, total) for label, (n, total) in hist.items()]
 
 
+def _num(value) -> float | None:
+    """A float, or None for anything a half-written file might hold."""
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return None
+
+
+def load_health(output_dir: str) -> tuple[dict, str]:
+    """(health dict, status) where status is ok|missing|corrupt. The report
+    must DEGRADE on a missing or partially-written/garbage health.json (a
+    crashed run is exactly when this tool gets pointed at a dir), never
+    traceback — non-dict JSON ("null", a list) counts as corrupt too."""
+    path = os.path.join(output_dir, "health.json")
+    if not os.path.exists(path):
+        return {}, "missing"
+    try:
+        with open(path) as f:
+            health = json.load(f)
+    except (OSError, ValueError):
+        return {}, "corrupt"
+    if not isinstance(health, dict):
+        return {}, "corrupt"
+    return health, "ok"
+
+
+def incarnation_summary(output_dir: str) -> dict | None:
+    """Roll-up of the supervisor's goodput ledger (incarnations.jsonl, one
+    row per launch — tools/supervisor.py), or None when the run was never
+    supervised. Restart badput = wall seconds spent in incarnations that
+    did not end cleanly."""
+    rows = load_jsonl(os.path.join(output_dir, "incarnations.jsonl"))
+    rows = [r for r in rows if isinstance(r, dict)]
+    if not rows:
+        return None
+    failed = [r for r in rows if r.get("outcome") not in ("clean", None)]
+    return {
+        "incarnations": len(rows),
+        "restarts": max(len(rows) - 1, 0),
+        "crashes": sum(1 for r in failed if r.get("outcome") == "crash"),
+        "hangs": sum(1 for r in failed if r.get("outcome") == "hang"),
+        "lost_seconds": sum(_num(r.get("duration_s")) or 0.0 for r in failed),
+        "last_outcome": rows[-1].get("outcome"),
+    }
+
+
 def build_report(output_dir: str, top: int = 5) -> dict:
     spans = load_jsonl(os.path.join(output_dir, "spans.jsonl"))
     metrics = load_jsonl(os.path.join(output_dir, "metrics.jsonl"))
-    health = None
-    try:
-        with open(os.path.join(output_dir, "health.json")) as f:
-            health = json.load(f)
-    except (OSError, ValueError):
-        pass
+    health, health_status = load_health(output_dir)
     t0, t1 = wall_window(spans)
     buckets = bucket_table(spans)
     wall = t1 - t0
@@ -132,8 +183,10 @@ def build_report(output_dir: str, top: int = 5) -> dict:
         "wall_seconds": wall,
         "buckets": buckets,
         "goodput": buckets.get("train", 0.0) / max(wall, 1e-9),
-        "cumulative_goodput": (health or {}).get("goodput"),
-        "last_step": (health or {}).get("last_step"),
+        "health_status": health_status,
+        "cumulative_goodput": _num(health.get("goodput")),
+        "last_step": health.get("last_step"),
+        "incarnations": incarnation_summary(output_dir),
         "slowest_windows": slowest_windows(spans, metrics, top),
         "stall_histogram": stall_histogram(spans, "data_wait"),
         "prefetch_stalls": {
@@ -151,6 +204,17 @@ def print_report(rep: dict) -> None:
     print(f"run: {rep['output_dir']}  ({rep['spans']} spans, "
           f"{rep['metrics_lines']} metrics lines, last step "
           f"{rep['last_step']})")
+    if rep.get("health_status") != "ok":
+        print(f"  (health.json {rep['health_status']} — cumulative goodput / "
+              f"last-step fields degraded)")
+
+    inc = rep.get("incarnations")
+    if inc:
+        print(f"\n== incarnations (supervisor ledger) ==\n"
+              f"  {inc['incarnations']} launch(es), {inc['restarts']} "
+              f"restart(s): {inc['crashes']} crash(es), {inc['hangs']} "
+              f"hang(s); {inc['lost_seconds']:.1f} s lost to failed "
+              f"incarnations; last outcome: {inc['last_outcome']}")
 
     print(f"\n== time buckets: {wall:.2f} s wall ==")
     for name, secs in sorted(rep["buckets"].items(), key=lambda kv: -kv[1]):

@@ -1,0 +1,273 @@
+"""Supervised elastic restart for the trainer (docs/RESILIENCE.md).
+
+A watchdog that launches the training command, watches the run's
+`health.json` heartbeat (utils/trace.Heartbeat: `time` stale => process
+dead or wedged), and restarts crashed or hung incarnations within a
+bounded budget — the recovery half of the fault-tolerance story whose
+detection half PR 1's telemetry built. Each incarnation is appended to the
+goodput ledger `<output_dir>/incarnations.jsonl`, which
+tools/goodput_report.py folds into its report, so restart badput is
+visible next to the buckets it depresses.
+
+Usage:
+  python tools/supervisor.py --output-dir /runs/exp1 [options] -- \\
+      python train.py --config conf/llama_7b_pp4.yaml output_dir=/runs/exp1
+
+Behavior:
+- exit 0 from the child ends supervision (clean completion; the trainer's
+  own preemption save counts — it exits 0).
+- non-zero exit / signal death restarts the child, up to --max-restarts.
+- a heartbeat stale for --hang-timeout-s (or never appearing for that
+  long) marks the incarnation HUNG: SIGTERM (the trainer's graceful
+  checkpoint-and-exit path), --grace-s to comply, then SIGKILL.
+- crash-loop detection: --crash-loop-threshold consecutive failures each
+  younger than --crash-loop-window-s abort supervision (exit 3) — a
+  deterministic crash must page a human, not burn the restart budget.
+- SIGTERM/SIGINT to the supervisor forward to the child and stop the
+  restart loop (the pod-preemption path: the trainer saves, everyone
+  exits).
+
+Exit codes: 0 child completed; 2 restart budget exhausted; 3 crash loop;
+when the supervisor itself is stopped, the child's own exit code (a
+signal death maps to the shell convention 128+N).
+
+Resume correctness is the trainer's job (checkpoint integrity + fallback,
+loader fast-forward); the supervisor only guarantees a fresh incarnation
+gets launched with the same command line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any
+
+LEDGER_NAME = "incarnations.jsonl"
+HEALTH_NAME = "health.json"
+
+
+def _now() -> float:
+    return time.time()
+
+
+def read_health(output_dir: str) -> dict | None:
+    """The run's health.json, or None when absent/torn/not-a-dict (the
+    writer is atomic, but the supervisor must survive any on-disk state)."""
+    try:
+        with open(os.path.join(output_dir, HEALTH_NAME)) as f:
+            health = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return health if isinstance(health, dict) else None
+
+
+@dataclasses.dataclass
+class SupervisorConfig:
+    output_dir: str
+    max_restarts: int = 5
+    hang_timeout_s: float = 300.0
+    grace_s: float = 30.0
+    crash_loop_threshold: int = 3
+    crash_loop_window_s: float = 120.0
+    poll_s: float = 1.0
+
+
+class Supervisor:
+    """Launch/watch/restart loop. Separated from main() so chaos tests can
+    drive it in-process with fast timeouts."""
+
+    def __init__(self, cmd: list[str], cfg: SupervisorConfig,
+                 env: dict[str, str] | None = None):
+        if not cmd:
+            raise ValueError("supervisor needs a command to run")
+        self.cmd = cmd
+        self.cfg = cfg
+        self.env = env
+        self._child: subprocess.Popen | None = None
+        self._stop_signal: int | None = None
+        self._ledger_path = os.path.join(cfg.output_dir, LEDGER_NAME)
+        os.makedirs(cfg.output_dir, exist_ok=True)
+
+    # -- ledger ------------------------------------------------------------
+
+    def _log_incarnation(self, rec: dict[str, Any]) -> None:
+        """Append one incarnation row to the goodput ledger. Plain append:
+        the supervisor is the file's only writer."""
+        with open(self._ledger_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    # -- signal forwarding ---------------------------------------------------
+
+    def _forward_signal(self, sig, _frame) -> None:
+        self._stop_signal = sig
+        child = self._child
+        if child is not None and child.poll() is None:
+            try:
+                child.send_signal(sig)
+            except OSError:
+                pass
+
+    # -- one incarnation -----------------------------------------------------
+
+    def _heartbeat_age(self, started_at: float) -> float:
+        """Seconds since the run last proved liveness: health.json's `time`
+        field when present, else the incarnation launch (covers the init
+        window before the Heartbeat thread exists — size --hang-timeout-s
+        for the model-build+restore+compile phase, not just step cadence)."""
+        health = read_health(self.cfg.output_dir)
+        last = started_at
+        if health is not None:
+            try:
+                t = float(health.get("time", 0.0))
+            except (TypeError, ValueError):
+                t = 0.0
+            # a stale file from a PREVIOUS incarnation must not vouch for
+            # this one before it ever writes
+            if t > started_at:
+                last = t
+        return _now() - last
+
+    def _kill_hung(self, child: subprocess.Popen) -> None:
+        """SIGTERM (the trainer checkpoints and exits cleanly), grace, then
+        SIGKILL."""
+        try:
+            child.terminate()
+        except OSError:
+            return
+        try:
+            child.wait(timeout=self.cfg.grace_s)
+        except subprocess.TimeoutExpired:
+            try:
+                child.kill()
+            except OSError:
+                pass
+            child.wait()
+
+    def _run_once(self, incarnation: int) -> dict:
+        started = _now()
+        print(f"[supervisor] incarnation {incarnation}: {' '.join(self.cmd)}",
+              flush=True)
+        child = subprocess.Popen(self.cmd, env=self.env)
+        self._child = child
+        outcome = "clean"
+        while True:
+            rc = child.poll()
+            if rc is not None:
+                if self._stop_signal is not None:
+                    outcome = "supervisor_stopped"
+                elif rc != 0:
+                    outcome = "crash"
+                break
+            if self._stop_signal is None \
+                    and self._heartbeat_age(started) > self.cfg.hang_timeout_s:
+                print(f"[supervisor] incarnation {incarnation} heartbeat "
+                      f"stale > {self.cfg.hang_timeout_s:.0f}s; killing "
+                      f"(SIGTERM, {self.cfg.grace_s:.0f}s grace, SIGKILL)",
+                      flush=True)
+                self._kill_hung(child)
+                rc = child.returncode
+                outcome = "hang"
+                break
+            time.sleep(self.cfg.poll_s)
+        self._child = None
+        ended = _now()
+        health = read_health(self.cfg.output_dir) or {}
+        rec = {
+            "incarnation": incarnation,
+            "start": started,
+            "end": ended,
+            "duration_s": round(ended - started, 3),
+            "exit_code": rc,
+            "outcome": outcome,
+            "last_step": health.get("last_step"),
+            "goodput": health.get("goodput"),
+        }
+        self._log_incarnation(rec)
+        print(f"[supervisor] incarnation {incarnation} ended: "
+              f"outcome={outcome} exit={rc} last_step={rec['last_step']}",
+              flush=True)
+        return rec
+
+    # -- the loop ------------------------------------------------------------
+
+    def run(self) -> int:
+        prev_handlers = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev_handlers[sig] = signal.signal(sig, self._forward_signal)
+            except ValueError:  # not the main thread (in-process tests)
+                pass
+        try:
+            failures: list[dict] = []  # consecutive non-clean incarnations
+            for incarnation in range(self.cfg.max_restarts + 1):
+                rec = self._run_once(incarnation)
+                if rec["outcome"] == "clean":
+                    return 0
+                if rec["outcome"] == "supervisor_stopped":
+                    # pod preemption of the supervisor itself: the child was
+                    # told, saved, and exited; propagate its code. A signal
+                    # death maps to the shell convention 128+N — a raw
+                    # negative returncode through sys.exit() would come out
+                    # as an unrelated status (e.g. -15 -> 241)
+                    rc = rec["exit_code"] or 0
+                    return 128 - rc if rc < 0 else rc
+                failures.append(rec)
+                tail = failures[-self.cfg.crash_loop_threshold:]
+                if (len(tail) >= self.cfg.crash_loop_threshold
+                        and all(f["duration_s"] < self.cfg.crash_loop_window_s
+                                for f in tail)):
+                    print(f"[supervisor] crash loop: last {len(tail)} "
+                          f"incarnations each died within "
+                          f"{self.cfg.crash_loop_window_s:.0f}s; giving up",
+                          flush=True)
+                    return 3
+            print(f"[supervisor] restart budget exhausted "
+                  f"({self.cfg.max_restarts} restarts)", flush=True)
+            return 2
+        finally:
+            for sig, handler in prev_handlers.items():
+                signal.signal(sig, handler)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--output-dir", required=True,
+                   help="the trainer's output_dir (health.json + the "
+                        "incarnations.jsonl ledger live here)")
+    p.add_argument("--max-restarts", type=int, default=5,
+                   help="restarts after the first launch (default 5)")
+    p.add_argument("--hang-timeout-s", type=float, default=300.0,
+                   help="heartbeat staleness that declares a hang; must "
+                        "cover the init+compile window (default 300)")
+    p.add_argument("--grace-s", type=float, default=30.0,
+                   help="SIGTERM->SIGKILL grace for a hung child (default 30)")
+    p.add_argument("--crash-loop-threshold", type=int, default=3,
+                   help="consecutive fast failures that abort (default 3)")
+    p.add_argument("--crash-loop-window-s", type=float, default=120.0,
+                   help="a failure younger than this counts toward the "
+                        "crash loop (default 120)")
+    p.add_argument("--poll-s", type=float, default=1.0,
+                   help="watchdog poll interval (default 1)")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="the training command, after `--`")
+    args = p.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+    if not cmd:
+        p.error("no training command given (append `-- python train.py ...`)")
+    sup = Supervisor(cmd, SupervisorConfig(
+        output_dir=args.output_dir, max_restarts=args.max_restarts,
+        hang_timeout_s=args.hang_timeout_s, grace_s=args.grace_s,
+        crash_loop_threshold=args.crash_loop_threshold,
+        crash_loop_window_s=args.crash_loop_window_s, poll_s=args.poll_s))
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
