@@ -216,6 +216,12 @@ class HostOffloadAdamW:
     """
 
     cfg: OptimizerConfig
+    # Numerics-observatory skip semantics (utils/numerics.py, mirroring the
+    # fused step's in-graph guard): when the global grad norm is nonfinite,
+    # leave masters/moments/step-count untouched for this step — the working
+    # copy re-uploads unchanged. `last_nonfinite` flags the verdict either
+    # way; `nonfinite_count` accumulates skips.
+    skip_nonfinite: bool = False
     # Compute the global grad norm ON DEVICE (one fused XLA reduction + a
     # scalar D2H) instead of on the host after the full-tree D2H. The host
     # path must pull EVERY gradient byte down before the first AdamW can run
@@ -239,6 +245,8 @@ class HostOffloadAdamW:
         self._native = _load_native()
         self._norm_sq_jit = None
         self.last_timings: dict = {}
+        self.last_nonfinite = False
+        self.nonfinite_count = 0
 
     # -- master access ----------------------------------------------------
 
@@ -371,14 +379,30 @@ class HostOffloadAdamW:
 
     def _clip_and_advance(self, norm: float) -> tuple[float, float]:
         """Shared epilogue of both norm paths: clip factor from the global
-        norm, step count, lr sample, telemetry."""
+        norm, step count, lr sample, telemetry. A nonfinite norm under
+        `skip_nonfinite` advances NOTHING (no step count, no moments later —
+        the apply loops check `last_nonfinite`), matching the fused step's
+        in-graph where-skip."""
+        import math
+
+        self.last_nonfinite = not math.isfinite(norm)
+        self.last_grad_norm = norm
+        if self.last_nonfinite and self.skip_nonfinite:
+            self.nonfinite_count += 1
+            self.last_lr = float(self._schedule(self.step_count))
+            logger.warning("nonfinite global grad norm (%r); skipping the "
+                           "optimizer step (%d skipped so far)", norm,
+                           self.nonfinite_count)
+            return self.last_lr, 0.0
         clip = self.cfg.max_grad_norm
         grad_scale = clip / norm if (clip and norm > clip) else 1.0
         self.step_count += 1
         lr = float(self._schedule(self.step_count - 1))
         self.last_lr = lr
-        self.last_grad_norm = norm
         return lr, grad_scale
+
+    def _skip_this_step(self) -> bool:
+        return self.skip_nonfinite and self.last_nonfinite
 
     def _apply_shard(self, shard: _Shard, g: np.ndarray, lr: float,
                      grad_scale: float) -> None:
@@ -399,9 +423,10 @@ class HostOffloadAdamW:
         grad_np, lr, grad_scale = self._gather_grads_and_norm(
             self._check_tree(grads_tree))
         t1 = time.perf_counter()
-        for leaf, gnp in zip(self._leaves, grad_np):
-            for key, shard in leaf.shards.items():
-                self._apply_shard(shard, gnp[key], lr, grad_scale)
+        if not self._skip_this_step():
+            for leaf, gnp in zip(self._leaves, grad_np):
+                for key, shard in leaf.shards.items():
+                    self._apply_shard(shard, gnp[key], lr, grad_scale)
         t2 = time.perf_counter()
         # fresh dict: a stale phase key from the OTHER step path must not
         # linger in the metrics stream (d2h_norm_ms covers transfers AND the
@@ -473,7 +498,8 @@ class HostOffloadAdamW:
                     for k, v in leaf.grad_shards(g).items()})
             cast = {}
             for key, shard in leaf.shards.items():
-                self._apply_shard(shard, gnp[key], lr, grad_scale)
+                if not self._skip_this_step():
+                    self._apply_shard(shard, gnp[key], lr, grad_scale)
                 cast[key] = self._cast_working(shard.p, dtype)
             # assemble dispatches this leaf's H2D asynchronously; the next
             # leaf's AdamW kernels run while these bytes are on the wire

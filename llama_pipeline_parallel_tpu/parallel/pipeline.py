@@ -445,16 +445,36 @@ def _slot_valid(pcfg: PipelineConfig, stage, tp_size: int, sp_size: int,
     counts = jnp.asarray(pcfg.layer_counts, jnp.int32)
     return jnp.arange(k_max) < counts[stage]
 
+def _act_stat_update(carry: tuple, y: jnp.ndarray, valid) -> tuple:
+    """Fold one tick's stage-boundary activation into the running
+    (absmax, mean-square sum, tick count) accumulators — the per-stage
+    numerics-observatory stats (utils/numerics.py). `stop_gradient` keeps
+    the reductions out of any AD transpose (gpipe differentiates the scan
+    these accumulators ride in)."""
+    absmax, msq_sum, n = carry
+    yf = jax.lax.stop_gradient(y).astype(jnp.float32)
+    absmax = jnp.maximum(absmax,
+                         jnp.where(valid, jnp.max(jnp.abs(yf)), 0.0))
+    msq_sum = msq_sum + jnp.where(valid, jnp.mean(jnp.square(yf)), 0.0)
+    return absmax, msq_sum, n + valid.astype(jnp.float32)
+
+
+_ACT_STATS_ZERO = lambda: (jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0))
+
+
 def _pipeline_loss_local(
     params: Params,
     batch: Batch,
     cfg: LlamaConfig,
     pcfg: PipelineConfig,
     attn_fn: Callable = attention,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
+    collect_stats: bool = False,
+) -> tuple:
     """Runs INSIDE shard_map. Local views: layer leaves [1, k, ...]; batch is
     this dp-shard's [M*mb, L]. Returns local (loss_sum, token_count) pairs
-    (pre-psum). The caller reduces and differentiates."""
+    (pre-psum) — plus, with `collect_stats`, this stage's activation
+    (absmax, mean-square sum, tick count) accumulators over its LIVE ticks.
+    The caller reduces and differentiates."""
     s_total = pcfg.num_stages
     m_total = pcfg.num_microbatches
     stage = jax.lax.axis_index(AXIS_PP)
@@ -521,7 +541,7 @@ def _pipeline_loss_local(
     mb_loss = jax.checkpoint(mb_loss)
 
     def tick(carry, t):
-        x_prev, loss_sum, count = carry
+        x_prev, loss_sum, count, act_stats = carry
         # Microbatch indices for this tick: stage 0 consumes microbatch t;
         # this stage computes microbatch (t - stage).
         in_idx = jnp.clip(t, 0, m_total - 1)
@@ -561,20 +581,29 @@ def _pipeline_loss_local(
         loss_sum = loss_sum + jnp.where(take, mb_sum, 0.0)
         count = count + jnp.where(take, mb_count, 0)
 
+        if collect_stats:
+            # Stage-boundary activation stats over this stage's LIVE ticks
+            # (warmup/drain ticks recompute a clipped microbatch — masked).
+            live = (my_idx >= 0) & (my_idx < m_total)
+            act_stats = _act_stat_update(act_stats, y, live)
+
         # Hand off to the next stage over the ICI ring (NCCL-P2P analogue).
         if s_total > 1:
             perm = [(i, (i + 1) % s_total) for i in range(s_total)]
             x_next = jax.lax.ppermute(y, AXIS_PP, perm)
         else:
             x_next = y
-        return (x_next, loss_sum, count), None
+        return (x_next, loss_sum, count, act_stats), None
 
-    (_, loss_sum, count), _ = jax.lax.scan(
-        tick, (x_init, jnp.float32(0.0), jnp.int32(0)), jnp.arange(num_ticks))
+    (_, loss_sum, count, act_stats), _ = jax.lax.scan(
+        tick, (x_init, jnp.float32(0.0), jnp.int32(0), _ACT_STATS_ZERO()),
+        jnp.arange(num_ticks))
 
     # Only the last stage's numbers are real.
     loss_sum = jnp.where(is_last, loss_sum, 0.0)
     count = jnp.where(is_last, count, 0)
+    if collect_stats:
+        return loss_sum, count, act_stats
     return loss_sum, count
 
 
@@ -585,7 +614,8 @@ def _pipeline_1f1b_local(
     pcfg: PipelineConfig,
     attn_fn: Callable,
     global_count: jnp.ndarray,
-) -> tuple[jnp.ndarray, Params]:
+    collect_stats: bool = False,
+) -> tuple:
     """One-forward-one-backward schedule with a hand-written backward.
 
     Runs INSIDE shard_map; returns this shard's (normalized loss, grads) —
@@ -727,7 +757,7 @@ def _pipeline_1f1b_local(
     hidden_shape = (mb, seqlen, cfg.hidden_size)
 
     def tick(carry, t):
-        x_recv, dy_recv, xbuf, gacc, loss_acc = carry
+        x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats = carry
 
         if s_total > 1:
             # -- forward half: microbatch t - stage -----------------------
@@ -762,7 +792,13 @@ def _pipeline_1f1b_local(
             return stage_fwd(p, x_in, ids_b, pad_b, cos_b, sin_b, targets_b,
                              with_loss=True, loss_gate=b_valid)
 
-        (_, mb_sum), pullback = jax.vjp(h, params, x_in_b)
+        (y_b, mb_sum), pullback = jax.vjp(h, params, x_in_b)
+        if collect_stats:
+            # Stage-boundary activation stats from the backward half's
+            # recompute (the same activation the forward produced; using the
+            # backward side covers S=1, whose forward half is skipped, with
+            # the same b_valid gate as the loss).
+            act_stats = _act_stat_update(act_stats, y_b, b_valid)
         # vjp is linear in the cotangent, so masked-out ticks (zero seeds)
         # contribute exactly zero to the accumulators — no outer `where`.
         dy_ct = jnp.where(b_valid & ~is_last, 1.0, 0.0).astype(cfg.dtype) * dy_recv
@@ -779,7 +815,7 @@ def _pipeline_1f1b_local(
             dy_next = jax.lax.ppermute(dx, AXIS_PP, bwd_perm)
         else:
             x_next, dy_next = x_recv, dx  # no neighbors; both carries dead
-        return (x_next, dy_next, xbuf, gacc, loss_acc), None
+        return (x_next, dy_next, xbuf, gacc, loss_acc, act_stats), None
 
     carry0 = (
         jnp.zeros(hidden_shape, cfg.dtype),
@@ -787,15 +823,20 @@ def _pipeline_1f1b_local(
         jnp.zeros((b_slots,) + hidden_shape, cfg.dtype),
         jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), params),
         jnp.float32(0.0),
+        _ACT_STATS_ZERO(),
     )
-    (_, _, _, grads, loss_acc), _ = jax.lax.scan(
+    (_, _, _, grads, loss_acc, act_stats), _ = jax.lax.scan(
         tick, carry0, jnp.arange(num_ticks))
     # loss_acc is nonzero on the last stage only (cond zero branch elsewhere)
+    if collect_stats:
+        return loss_acc / global_count, grads, act_stats
     return loss_acc / global_count, grads
 
 
-def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn):
-    """shard_map body: global-mean loss + fully reduced grads.
+def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn,
+                         collect_stats=False):
+    """shard_map body: global-mean loss + fully reduced grads (+ per-stage
+    activation stats when `collect_stats` — see utils/numerics.py).
 
     All `psum`s happen OUTSIDE `value_and_grad`: differentiating through a
     psum under shard_map with replication checking off re-reduces the already
@@ -817,18 +858,25 @@ def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn):
 
     if pcfg.schedule == "1f1b":
         def chunk_loss_and_grad(p, chunk_batch):
-            return _pipeline_1f1b_local(p, chunk_batch, cfg, chunk_pcfg, attn_fn,
-                                        global_count)
+            out = _pipeline_1f1b_local(p, chunk_batch, cfg, chunk_pcfg, attn_fn,
+                                       global_count,
+                                       collect_stats=collect_stats)
+            return out if collect_stats else (*out, _ACT_STATS_ZERO())
     else:
         def chunk_loss(p, chunk_batch):
-            loss_sum, _ = _pipeline_loss_local(p, chunk_batch, cfg, chunk_pcfg, attn_fn)
-            return loss_sum / global_count  # nonzero on the last stage only
+            out = _pipeline_loss_local(p, chunk_batch, cfg, chunk_pcfg, attn_fn,
+                                       collect_stats=collect_stats)
+            # nonzero on the last stage only; stats ride as AD aux
+            stats = out[2] if collect_stats else _ACT_STATS_ZERO()
+            return out[0] / global_count, stats
 
         def chunk_loss_and_grad(p, chunk_batch):
-            return jax.value_and_grad(chunk_loss)(p, chunk_batch)
+            (l, stats), g = jax.value_and_grad(chunk_loss, has_aux=True)(
+                p, chunk_batch)
+            return l, g, stats
 
     if chunks == 1:
-        local_loss, grads = chunk_loss_and_grad(params, batch)
+        local_loss, grads, act_stats = chunk_loss_and_grad(params, batch)
     else:
         # Sequential pipeline flushes: each chunk's fwd+bwd completes (and its
         # activations are freed) before the next starts; grads accumulate in
@@ -838,13 +886,17 @@ def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn):
             lambda x: x.reshape((chunks, x.shape[0] // chunks) + x.shape[1:]), batch)
 
         def accum(carry, chunk_batch):
-            acc_loss, acc_grads = carry
-            l, g = chunk_loss_and_grad(params, chunk_batch)
-            return (acc_loss + l, jax.tree.map(jnp.add, acc_grads, g)), None
+            acc_loss, acc_grads, acc_stats = carry
+            l, g, s = chunk_loss_and_grad(params, chunk_batch)
+            # stats fold across chunks: max of absmax, sums of (msq, n)
+            stats = (jnp.maximum(acc_stats[0], s[0]),
+                     acc_stats[1] + s[1], acc_stats[2] + s[2])
+            return (acc_loss + l, jax.tree.map(jnp.add, acc_grads, g),
+                    stats), None
 
         zero_grads = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), params)
-        (local_loss, grads), _ = jax.lax.scan(
-            accum, (jnp.float32(0.0), zero_grads), chunked)
+        (local_loss, grads, act_stats), _ = jax.lax.scan(
+            accum, (jnp.float32(0.0), zero_grads, _ACT_STATS_ZERO()), chunked)
     loss = jax.lax.psum(local_loss, (AXIS_PP, AXIS_DP, AXIS_SP))
 
     # Stage-sharded leaves: reduce across dp replicas and sp shards (each sp
@@ -854,7 +906,21 @@ def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn):
     grads["layers"] = jax.lax.psum(grads["layers"], (AXIS_DP, AXIS_SP))
     for key in ("embed", "norm", "lm_head"):
         grads[key] = jax.lax.psum(grads[key], (AXIS_PP, AXIS_DP, AXIS_SP))
-    return loss, grads
+    if not collect_stats:
+        return loss, grads
+
+    # Per-stage activation stats stay STAGE-LOCAL over pp (out_spec P(pp)
+    # stitches the [1]-shaped shard values into the global [S] vector) but
+    # must be replicated over dp/sp/tp for the out_spec to be truthful:
+    # absmax -> pmax, rms -> tick-weighted mean of mean-squares.
+    absmax, msq_sum, n = act_stats
+    absmax = jax.lax.pmax(absmax, (AXIS_DP, AXIS_SP, AXIS_TP))
+    msq = (jax.lax.psum(msq_sum, (AXIS_DP, AXIS_SP))
+           / jnp.maximum(jax.lax.psum(n, (AXIS_DP, AXIS_SP)), 1.0))
+    msq = jax.lax.pmax(msq, AXIS_TP)  # tp replicas agree; pmax re-asserts it
+    stats = {"act_absmax_per_stage": absmax.reshape(1),
+             "act_rms_per_stage": jnp.sqrt(msq).reshape(1)}
+    return loss, grads, stats
 
 
 def make_pipeline_eval_fn(
@@ -898,10 +964,15 @@ def make_pipeline_loss_and_grad(
     pcfg: PipelineConfig,
     params_like: Params,
     attn_fn: Callable = attention,
-) -> Callable[[Params, Batch], tuple[jnp.ndarray, Params]]:
+    collect_stats: bool = False,
+) -> Callable[[Params, Batch], tuple]:
     """Build the (jit-able) SPMD loss+grad function over stage-stacked params.
 
     `params_like` supplies the pytree structure for spec construction only.
+    `collect_stats` adds a third output: the numerics observatory's
+    per-stage stage-boundary activation stats, `{"act_absmax_per_stage",
+    "act_rms_per_stage"}` as [num_stages] arrays sharded over pp — computed
+    in-graph (utils/numerics.py; no host round-trip).
     """
     if mesh.shape[AXIS_PP] != pcfg.num_stages:
         raise ValueError(
@@ -953,11 +1024,16 @@ def make_pipeline_loss_and_grad(
         attn_fn = make_sp_attention(pcfg.sequence_parallel, attn_fn,
                                     packed=pcfg.packed)
 
+    out_specs: tuple = (P(), param_specs)
+    if collect_stats:
+        out_specs += ({"act_absmax_per_stage": P(AXIS_PP),
+                       "act_rms_per_stage": P(AXIS_PP)},)
     fn = shard_map(
-        partial(_loss_and_grad_local, cfg=cfg, pcfg=pcfg, attn_fn=attn_fn),
+        partial(_loss_and_grad_local, cfg=cfg, pcfg=pcfg, attn_fn=attn_fn,
+                collect_stats=collect_stats),
         mesh=mesh,
         in_specs=(param_specs, batch_specs(mesh)),
-        out_specs=(P(), param_specs),
+        out_specs=out_specs,
         check_vma=False,
     )
     return fn

@@ -195,16 +195,40 @@ def make_train_step(
     schedule: optax.Schedule,
     params_like: Params,
     attn_fn: Callable | None = None,
-) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
-    """Build the donated, fully-sharded jitted train step."""
+    collect_stats: bool = False,
+    poison: bool = False,
+) -> Callable[..., tuple[TrainState, dict]]:
+    """Build the donated, fully-sharded jitted train step.
+
+    `collect_stats` (the numerics observatory, utils/numerics.py) adds
+    in-graph per-stage/per-layer-group statistics under `metrics["numerics"]`
+    AND arms the nonfinite guard: when any gradient leaf is nonfinite, the
+    parameter/optimizer update is `where`-skipped the same step (fp16
+    loss-scaler skip semantics; the step counter still advances so the LR
+    schedule stays aligned with the loop). Off (the default), the step is
+    bit-identical to the pre-observatory one.
+
+    `poison` (chaos only — the `grad_nonfinite` fault op) extends the jitted
+    signature with a third `poison_stage` scalar that multiplies one stage's
+    layer gradients by +inf (-1 = no-op). Steady-state runs never pass it,
+    so the per-step host->device traffic is unchanged.
+    """
     from llama_pipeline_parallel_tpu.ops.attention import attention
+    from llama_pipeline_parallel_tpu.utils import numerics
 
     loss_grad_fn = make_pipeline_loss_and_grad(
-        mesh, cfg, pcfg, params_like, attn_fn=attn_fn or attention)
+        mesh, cfg, pcfg, params_like, attn_fn=attn_fn or attention,
+        collect_stats=collect_stats)
     shardings = state_shardings(mesh, tx, params_like)
 
-    def step_fn(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
-        loss, grads = loss_grad_fn(state.params, batch)
+    def _step(state: TrainState, batch: dict, poison_stage
+              ) -> tuple[TrainState, dict]:
+        if collect_stats:
+            loss, grads, act_stats = loss_grad_fn(state.params, batch)
+        else:
+            loss, grads = loss_grad_fn(state.params, batch)
+        if poison_stage is not None:
+            grads = numerics.poison_grads(grads, poison_stage)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {
@@ -213,13 +237,43 @@ def make_train_step(
             "lr": schedule(state.step),
             "step": state.step + 1,
         }
+        if collect_stats:
+            stats = numerics.step_stats(state.params, grads, updates)
+            stats.update(act_stats)
+            # replicate the stat vectors (a few hundred floats): the host
+            # monitor reads them with np.asarray, which on a pod requires
+            # every process to hold the full value — without this the
+            # pp-sharded [S] outputs are not fully addressable off-host
+            stats = jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, P())), stats)
+            # nonfinite guard: keep the old params/opt-state when any grad
+            # leaf is nonfinite — the skip happens in-graph, the same step
+            finite = ~stats["nonfinite"]
+            new_params = jax.tree.map(
+                lambda new, old: jnp.where(finite, new, old),
+                new_params, state.params)
+            new_opt_state = jax.tree.map(
+                lambda new, old: jnp.where(finite, new, old),
+                new_opt_state, state.opt_state)
+            metrics["numerics"] = stats
         return TrainState(state.step + 1, new_params, new_opt_state), metrics
 
     batch_shardings = {k: NamedSharding(mesh, s)
                        for k, s in batch_specs(mesh).items()}
+    if poison:
+        def step_fn(state, batch, poison_stage):
+            return _step(state, batch, poison_stage)
+
+        in_shardings = (shardings, batch_shardings, None)
+    else:
+        def step_fn(state, batch):
+            return _step(state, batch, None)
+
+        in_shardings = (shardings, batch_shardings)
     return jax.jit(
         step_fn,
-        in_shardings=(shardings, batch_shardings),
+        in_shardings=in_shardings,
         out_shardings=(shardings, None),
         donate_argnums=(0,),
     )

@@ -54,7 +54,7 @@ from llama_pipeline_parallel_tpu.parallel.distributed import (
     set_barrier_timeout,
 )
 from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
-from llama_pipeline_parallel_tpu.utils import faults, trace
+from llama_pipeline_parallel_tpu.utils import faults, numerics, trace
 from llama_pipeline_parallel_tpu.utils.config import instantiate
 from llama_pipeline_parallel_tpu.utils.logging import get_logger
 from llama_pipeline_parallel_tpu.utils.metrics import (
@@ -505,6 +505,32 @@ def _run_training(cfg: dict) -> dict:
     # (pcfg.packed switches the ring's segment streams on).
     packing = _packing_factor(cfg)
     pcfg = build_pipeline_config(cfg, mesh_cfg, manifest)
+    # Numerics observatory (docs/OBSERVABILITY.md "Numerics"): per-stage
+    # training-dynamics stats computed in-graph, anomaly detection + the
+    # numerics.jsonl stream on the host. On by default — the in-graph
+    # reductions are a few hundred floats next to a pipeline step.
+    ncfg = numerics.NumericsConfig.from_cfg(cfg.get("numerics"))
+    if faults.has_rule("step", "grad_nonfinite"):
+        if not ncfg.enabled:
+            # the chaos op exists to exercise the observatory; without it
+            # the poison would NaN the params with no guard/skip/record
+            raise ValueError(
+                "fault plan contains a grad_nonfinite rule but "
+                "numerics.enabled is false — the nonfinite guard would be "
+                "unarmed; enable numerics or drop the rule")
+        bad = [s for s in faults.rule_field_values(
+                   "step", "grad_nonfinite", "stage")
+               if not 0 <= s < pcfg.num_stages]
+        if bad:
+            # an out-of-range stage would make the poison mask all-ones: the
+            # drill "passes" while exercising nothing
+            raise ValueError(
+                f"grad_nonfinite rule stage(s) {bad} out of range for "
+                f"num_stages={pcfg.num_stages}")
+    monitor = (numerics.NumericsMonitor(output_dir, ncfg,
+                                        write=jax.process_index() == 0,
+                                        recorder=trace.recorder())
+               if ncfg.enabled else None)
 
     dataset, collator = build_dataset_and_collator(cfg, model_cfg)
     micro_batch = cfg.get("per_device_train_batch_size", 1)
@@ -547,7 +573,8 @@ def _run_training(cfg: dict) -> dict:
 
     if cfg.get("optimizer_offload"):
         return _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg,
-                            dataset, collator, loader, end_step, stacked_template, mgr)
+                            dataset, collator, loader, end_step, stacked_template, mgr,
+                            ncfg=ncfg, monitor=monitor)
     if cfg.get("optimizer_offload_zero2"):
         raise ValueError("optimizer_offload_zero2 requires optimizer_offload: "
                          "true (it shards the HOST-offloaded masters/grads "
@@ -595,15 +622,30 @@ def _run_training(cfg: dict) -> dict:
                                model_cfg=model_cfg,
                                packed=_packing_factor(cfg) > 1,
                                micro_batch=micro_batch)
+    # The poison input (the grad_nonfinite chaos op) is only compiled into
+    # the step when the active fault plan carries such a rule — steady-state
+    # runs keep the two-argument signature (no extra per-step H2D).
+    poison_on = faults.has_rule("step", "grad_nonfinite")
     step_fn = ts.make_train_step(mesh, model_cfg, pcfg, tx, schedule,
-                                 stacked_template, attn_fn=attn_fn)
+                                 stacked_template, attn_fn=attn_fn,
+                                 collect_stats=ncfg.enabled, poison=poison_on)
 
     # ---- loop -------------------------------------------------------------
     state_box = [state]
 
-    def do_step(batch):
-        new_state, metrics = step_fn(state_box[0], form_global_batch(mesh, batch))
+    def do_step(batch, step, fault=None):
+        gbatch = form_global_batch(mesh, batch)
+        if poison_on:
+            new_state, metrics = step_fn(state_box[0], gbatch,
+                                         numerics.fault_stage(fault))
+        else:
+            new_state, metrics = step_fn(state_box[0], gbatch)
         state_box[0] = new_state
+        if monitor is not None:
+            # async D2H enqueue + lag-1 processing; may raise
+            # NonfiniteHaltError (handled by _train_loop's halt path)
+            monitor.observe(step, metrics["loss"], metrics["grad_norm"],
+                            metrics.get("numerics"))
         return metrics["loss"], lambda: {"lr": float(metrics["lr"]),
                                          "grad_norm": float(metrics["grad_norm"])}
 
@@ -626,7 +668,8 @@ def _run_training(cfg: dict) -> dict:
             cfg, model_cfg, mesh, loader, seq_length,
             resume_step, end_step, do_step, do_save, do_eval,
             extra_scalars=_packing_scalars(collator),
-            static_scalars={"bubble_fraction": round(pl.bubble_fraction(pcfg), 4)})
+            static_scalars={"bubble_fraction": round(pl.bubble_fraction(pcfg), 4)},
+            monitor=monitor)
     except BaseException:
         # join the in-flight commit, but never let ITS failure replace the
         # training exception that actually killed the run
@@ -758,17 +801,21 @@ def _packing_scalars(collator) -> Any:
 
 def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                 do_step, do_save, do_eval=None, extra_scalars=None,
-                static_scalars=None) -> tuple:
+                static_scalars=None, monitor=None) -> tuple:
     """The shared step/log/save/profile loop for both optimizer paths.
 
-    `do_step(batch) -> (loss_scalar, scalars_thunk)`; the thunk is only called
-    at logging boundaries so the hot loop never blocks on a D2H sync.
-    `do_save(step)` writes a full checkpoint. `do_eval() -> float` (optional)
-    runs every `eval_steps`. `extra_scalars() -> dict` (optional) contributes
-    host-side counters (e.g. packing drop rate) to every metrics line;
-    `static_scalars` (optional dict) are run constants (e.g. the schedule's
-    bubble fraction) repeated on every line so downstream joins need no
-    second file.
+    `do_step(batch, step, fault=None) -> (loss_scalar, scalars_thunk)`; the
+    thunk is only called at logging boundaries so the hot loop never blocks
+    on a D2H sync; `fault` forwards the step-site fault verdict (the
+    grad_nonfinite chaos op). `do_save(step)` writes a full checkpoint.
+    `do_eval() -> float` (optional) runs every `eval_steps`.
+    `extra_scalars() -> dict` (optional) contributes host-side counters
+    (e.g. packing drop rate) to every metrics line; `static_scalars`
+    (optional dict) are run constants (e.g. the schedule's bubble fraction)
+    repeated on every line so downstream joins need no second file.
+    `monitor` (numerics.NumericsMonitor, optional) feeds the heartbeat's
+    numerics fields and the metrics line's counters; its
+    `NonfiniteHaltError` is turned into a final checkpoint + re-raise here.
     """
     output_dir = cfg["output_dir"]
     # Scalars are replicated across processes: process 0 writes for the pod
@@ -801,7 +848,9 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
     clock.add("init", init_secs)
     rec.add_listener(clock.on_span)
     heartbeat = (trace.Heartbeat(output_dir, clock,
-                                 interval=cfg.get("health_interval", 10.0))
+                                 interval=cfg.get("health_interval", 10.0),
+                                 extra=monitor.health_fields
+                                 if monitor is not None else None)
                  if jax.process_index() == 0 else None)
     peak_bytes, peak_src = trace.device_peak_bytes()
     logger.info("device memory telemetry: %s (%s)",
@@ -841,6 +890,7 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
     final_loss = float("nan")
     preempted_at = None  # the step THIS process observed the stop at
     last_saved = -1
+    completed = resume_step  # steps whose update the live state reflects
     # Pods agree on preemption via a host collective; running it every step
     # would sync the hot loop, so check on a fixed cadence — the SAME steps on
     # every host (the decision must never depend on a host-local flag, or the
@@ -852,8 +902,10 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
     try:
         for step in range(resume_step, end_step):
             # chaos hook: a `die`/`stall` rule at a chosen step simulates
-            # preemption or a hung pod at an exact, reproducible point
-            faults.fire("step", step=step)
+            # preemption or a hung pod at an exact, reproducible point; a
+            # `grad_nonfinite` verdict rides into do_step to poison the
+            # jitted step's gradients (numerics observatory chaos input)
+            fault_verdict = faults.fire("step", step=step)
             # The sync point must be polled EVERY step with the loop's step id
             # (the protocol computes max-step+1 as the one safe stop step for
             # the whole pod); it returns True on every process at that same
@@ -880,18 +932,28 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                 trace_active = True
             with trace.span("data_wait", step=step):
                 batch = next(it)
-            if step == resume_step:
-                # First step: trace+XLA-compile happen synchronously inside
-                # the dispatch, and the value barrier catches the rest — so
-                # the whole first-step wall time lands in the compile bucket
-                # instead of smearing into the first window's train time.
-                with trace.span("compile_block", step=step) as sp:
-                    loss, scalars_thunk = do_step(batch)
-                    jax.block_until_ready(loss)
-                window_overhead += sp["dur"]  # keep compile out of step_time
-            else:
-                with trace.span("step_dispatch", step=step):
-                    loss, scalars_thunk = do_step(batch)
+            try:
+                if step == resume_step:
+                    # First step: trace+XLA-compile happen synchronously
+                    # inside the dispatch, and the value barrier catches the
+                    # rest — so the whole first-step wall time lands in the
+                    # compile bucket instead of smearing into the first
+                    # window's train time.
+                    with trace.span("compile_block", step=step) as sp:
+                        loss, scalars_thunk = do_step(batch, step + 1,
+                                                      fault=fault_verdict)
+                        jax.block_until_ready(loss)
+                    window_overhead += sp["dur"]  # compile not in step_time
+                else:
+                    with trace.span("step_dispatch", step=step):
+                        loss, scalars_thunk = do_step(batch, step + 1,
+                                                      fault=fault_verdict)
+            except numerics.NonfiniteHaltError:
+                # the monitor raises AFTER do_step committed this step's
+                # state — record that so the halt save labels it correctly
+                completed = step + 1
+                raise
+            completed = step + 1
             if heartbeat is not None:
                 heartbeat.beat(step + 1)
             if trace_active and (step + 1 >= profile_window[1] or step + 1 == end_step):
@@ -923,6 +985,8 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                                       **scalars_thunk(), **meter.read_and_reset(),
                                       **(extra_scalars() if extra_scalars else {}),
                                       **(static_scalars or {}),
+                                      **(monitor.scalars() if monitor is not None
+                                         else {}),
                                       "goodput": round(clock.goodput(), 4),
                                       "step_time": round(step_dur, 4),
                                       "device_peak_bytes": peak_bytes})
@@ -940,10 +1004,31 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                 do_save(step + 1)
                 last_saved = step + 1
                 window_overhead += time.perf_counter() - t_save
+        if monitor is not None:
+            # drain the lag-1 queue: the LAST step's nonfinite verdict must
+            # fire (halt included) before the final save decides what state
+            # it is committing
+            monitor.flush()
+    except numerics.NonfiniteHaltError as e:
+        # halt_on_nonfinite: the nonfinite update was already where-skipped
+        # in-graph, so the live state is finite — commit it through the PR 2
+        # checkpoint path, then exit nonzero (the supervisor's crash-loop
+        # budget sees a short, clean abort instead of hours of NaN steps).
+        # Save under `completed`, NOT e.step: the monitor's lag-1 fetch means
+        # the halt surfaces one step after the nonfinite one, and by then the
+        # state already reflects that later (clean, or also-skipped) step —
+        # labeling it e.step would make a resume re-apply a batch.
+        logger.error("halting on nonfinite gradients at step %d; writing a "
+                     "final checkpoint at step %d before exiting nonzero",
+                     e.step, completed)
+        do_save(completed, final=True)
+        raise
     finally:
         if trace_active:  # preemption break / exception inside the window
             jax.profiler.stop_trace()
             logger.info("profiler trace (early exit) written to %s/profile", output_dir)
+        if monitor is not None:
+            monitor.close()
         writer.close()
         if heartbeat is not None:
             heartbeat.stop()  # kills the daemon on every exit path; write()
@@ -993,7 +1078,8 @@ def _should_stop(local_flag: bool) -> bool:
 
 
 def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
-                 loader, end_step, stacked_template, mgr) -> dict:
+                 loader, end_step, stacked_template, mgr, ncfg=None,
+                 monitor=None) -> dict:
     """Host-offloaded-optimizer training setup (reference ZeRO-offload path,
     conf yaml:160-162): fp32 masters + Adam moments in host DRAM via
     optim/offload.py; the device holds only the bf16 working copy and runs
@@ -1012,6 +1098,8 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
     from llama_pipeline_parallel_tpu.optim.offload import HostOffloadAdamW
 
     output_dir = cfg["output_dir"]
+    if ncfg is None:
+        ncfg = numerics.NumericsConfig.from_cfg(cfg.get("numerics"))
     zero2 = bool(cfg.get("optimizer_offload_zero2"))
     if zero2 and mesh.shape["dp"] == 1:
         logger.info("optimizer_offload_zero2 has no effect at dp=1; "
@@ -1030,6 +1118,7 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
     # leaf-by-leaf instead of waiting for the full-tree grad D2H before the
     # first AdamW; offload_device_norm: false restores the host fp64 norm
     host = HostOffloadAdamW(ocfg,
+                            skip_nonfinite=ncfg.enabled,
                             device_norm=cfg.get("offload_device_norm", True))
     host.init(stacked_template)
     # fp32 masters now live on the host; drop the device fp32 init copy and
@@ -1092,12 +1181,46 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
                                packed=_packing_factor(cfg) > 1,
                                micro_batch=cfg.get("per_device_train_batch_size", 1))
     loss_and_grad = pl.make_pipeline_loss_and_grad(
-        mesh, model_cfg, pcfg, stacked_template, attn_fn=attn_fn)
+        mesh, model_cfg, pcfg, stacked_template, attn_fn=attn_fn,
+        collect_stats=ncfg.enabled)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def _replicate_stats(stats):
+        # stat outputs must be replicated (the shard_map leaves act stats
+        # pp-sharded): the monitor's host read requires every pod process
+        # to hold the full few-hundred-float value
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, PartitionSpec())), stats)
+
+    # The grad_nonfinite chaos op must poison grads BETWEEN loss+grad and
+    # the stats, which forces a separate stats dispatch; steady-state runs
+    # (no such rule) fold numerics.step_stats into the ONE jitted loss+grad
+    # program instead — no second traversal of the gradient tree per step.
+    poison_on = faults.has_rule("step", "grad_nonfinite")
+
+    def _grad_with_stats(p, batch):
+        loss, grads, act_stats = loss_and_grad(p, batch)
+        stats = numerics.step_stats(p, grads)
+        stats.update(act_stats)
+        return loss, grads, _replicate_stats(stats)
+
+    def _grad_chaos(p, batch):
+        # chaos mode computes grad/param stats in a separate post-poison
+        # dispatch, but the act stats still leave here — replicated, or a
+        # pod process couldn't read its non-addressable pp shards
+        loss, grads, act_stats = loss_and_grad(p, batch)
+        return loss, grads, _replicate_stats(act_stats)
+
+    grad_out = loss_and_grad if not ncfg.enabled else (
+        _grad_chaos if poison_on else _grad_with_stats)
     if zero2:
         # grads leave the device dp-SHARDED: GSPMD turns the shard_map's dp
         # psum + the output constraint into a reduce-scatter, and each host
         # then D2H-pulls only its 1/dp of every gradient tree
-        grad_fn = jax.jit(loss_and_grad, out_shardings=(None, z2_shardings))
+        out_shardings = ((None, z2_shardings, None) if ncfg.enabled
+                         else (None, z2_shardings))
+        grad_fn = jax.jit(grad_out, out_shardings=out_shardings)
         # the pipeline consumes dp-REPLICATED bf16 params: re-gather the
         # dp-sharded upload over ICI once per step
         replicated = ts.specs_to_shardings(
@@ -1105,17 +1228,38 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
                                        tp=mesh.shape["tp"] > 1))
         to_replicated = jax.jit(lambda p: p, out_shardings=replicated)
     else:
-        grad_fn = jax.jit(loss_and_grad)
+        grad_fn = jax.jit(grad_out)
         to_replicated = lambda p: p
 
     device_params_box = [to_replicated(host.device_params(model_cfg.dtype))]
+    # chaos-only second dispatch: the stats must see the POISONED grads
+    stats_fn = (jax.jit(
+        lambda p, g: _replicate_stats(numerics.step_stats(p, g)))
+        if ncfg.enabled and poison_on else None)
+    poison_fn = jax.jit(numerics.poison_grads)
 
-    def do_step(batch):
-        loss, grads = grad_fn(device_params_box[0], form_global_batch(mesh, batch))
+    def do_step(batch, step, fault=None):
+        gbatch = form_global_batch(mesh, batch)
+        stats = None
+        if not ncfg.enabled:
+            loss, grads = grad_fn(device_params_box[0], gbatch)
+        elif not poison_on:
+            loss, grads, stats = grad_fn(device_params_box[0], gbatch)
+        else:
+            loss, grads, act_stats = grad_fn(device_params_box[0], gbatch)
+            stage = numerics.fault_stage(fault)
+            if stage >= 0:
+                grads = poison_fn(grads, stage)
+            stats = stats_fn(device_params_box[0], grads)
+            stats.update(act_stats)
         # fused step: per-leaf AdamW overlaps the previous leaf's bf16 cast
         # + H2D upload instead of a serial update-all-then-upload-all
+        # (a nonfinite global norm skips the masters update, see
+        # HostOffloadAdamW.skip_nonfinite)
         device_params_box[0] = to_replicated(
             host.update_and_refresh(grads, model_cfg.dtype))
+        if monitor is not None:
+            monitor.observe(step, loss, host.last_grad_norm, stats)
         return loss, lambda: {"lr": host.last_lr,
                               "grad_norm": host.last_grad_norm,
                               **{k: round(v, 2)
@@ -1135,6 +1279,7 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
         cfg, model_cfg, mesh, loader, seq_length,
         resume_step, end_step, do_step, do_save, do_eval,
         extra_scalars=_packing_scalars(collator),
-        static_scalars={"bubble_fraction": round(pl.bubble_fraction(pcfg), 4)})
+        static_scalars={"bubble_fraction": round(pl.bubble_fraction(pcfg), 4)},
+        monitor=monitor)
     return _summarize(final_loss, preempted_at, end_step, len(loader),
                       output_dir)

@@ -46,6 +46,12 @@ Ops:
             payload (the loader turns the record into a read failure)
   die       kill this process with `signal` (simulates preemption/crash —
             mid-async-save when attached to the ckpt_commit site)
+  grad_nonfinite  (`step` site only) `fire()` returns
+            "grad_nonfinite:<stage>" and the trainer poisons that pipeline
+            stage's layer gradients to +-inf/nan INSIDE the jitted step
+            (utils/numerics.poison_grads) — the chaos input for the
+            numerics observatory's same-step detect/skip/localize contract.
+            Extra field `stage` (default 0) picks the stage.
 
 Sites threaded through the codebase: `storage_write` (checkpoint file
 I/O), `ckpt_commit` (between array durability and the meta/tag write),
@@ -69,7 +75,7 @@ logger = get_logger(__name__)
 
 ENV_PLAN = "LPT_FAULT_PLAN"
 
-_OPS = ("error", "stall", "slow", "corrupt", "die")
+_OPS = ("error", "stall", "slow", "corrupt", "die", "grad_nonfinite")
 _SITES = ("storage_write", "ckpt_commit", "barrier", "data_read", "step")
 
 
@@ -86,7 +92,7 @@ class _Rule:
     def __init__(self, spec: dict, index: int, rng_seed: int):
         unknown = set(spec) - {"site", "op", "match", "at_step", "after",
                                "times", "every", "p", "marker", "seconds",
-                               "signal"}
+                               "signal", "stage"}
         if unknown:
             raise FaultPlanError(f"fault rule #{index}: unknown keys {sorted(unknown)}")
         try:
@@ -108,6 +114,7 @@ class _Rule:
         self.p = spec.get("p")
         self.marker = spec.get("marker")
         self.seconds = float(spec.get("seconds", 0.0))
+        self.stage = int(spec.get("stage", 0))
         self.signal = spec.get("signal", "SIGKILL")
         if not hasattr(_signal, self.signal):
             raise FaultPlanError(f"fault rule #{index}: unknown signal {self.signal!r}")
@@ -186,6 +193,10 @@ class FaultInjector:
             elif rule.op == "corrupt":
                 logger.warning("%s: corrupting payload", desc)
                 verdict = "corrupt"
+            elif rule.op == "grad_nonfinite":
+                logger.warning("%s: poisoning stage %d gradients nonfinite",
+                               desc, rule.stage)
+                verdict = f"grad_nonfinite:{rule.stage}"
             elif rule.op == "die":
                 # raw stderr write then a hard kill: the point is an unclean
                 # death (no atexit, no finally) — exactly what a preempted
@@ -253,3 +264,24 @@ def fire(site: str, tag: str = "", step: int | None = None) -> str | None:
     if inj is None:
         return None
     return inj.fire(site, tag, step=step)
+
+
+def has_rule(site: str, op: str) -> bool:
+    """Does the active plan carry a rule for (site, op)? Build-time probe:
+    the trainer only compiles the chaos-only poison input into the jitted
+    step when a grad_nonfinite rule exists, so steady-state runs keep the
+    unchanged two-argument step signature."""
+    inj = active()
+    return inj is not None and any(
+        r.site == site and r.op == op for r in inj._rules)
+
+
+def rule_field_values(site: str, op: str, field: str) -> list:
+    """Every matching rule's value for one field (e.g. the grad_nonfinite
+    `stage`s) — lets the trainer validate plan fields it alone can bound
+    (a stage index only means something against the pipeline's mesh)."""
+    inj = active()
+    if inj is None:
+        return []
+    return [getattr(r, field) for r in inj._rules
+            if r.site == site and r.op == op]

@@ -1,0 +1,453 @@
+"""Numerics observatory: per-stage training-dynamics telemetry.
+
+PR 1 answered *where wall-clock goes* (utils/trace.py) and PR 2 made
+crashes survivable (utils/faults.py + ckpt integrity); this layer answers
+*what the optimization is doing* — the question the reference punted to
+wandb eyeballing of a single scalar (reference trainer_base_ds_mp.py:360-374):
+a loss spike, a silently exploding pipeline stage, or a NaN born in one
+microbatch used to surface only as a bad `loss` many steps later.
+
+Three cooperating pieces:
+
+- **In-graph statistics** (`step_stats`, plus the activation stats the
+  pipeline schedules accumulate per stage — parallel/pipeline.py): cheap
+  norm/absmax/rms reductions computed ON DEVICE inside the jitted step from
+  the stage-stacked trees (layer leaves are `[num_stages, k, ...]`, so a
+  per-stage reduction is one axis-preserving `sum`/`max` — no gather, no
+  reshape). Nothing here ever moves host→device: the only traffic is the
+  stats' device→host fetch, which `NumericsMonitor` starts asynchronously
+  (`copy_to_host_async`) and reads one step later, so the dispatch pipeline
+  never stalls on a D2H sync.
+- **Nonfinite guard**: the fused train step computes an all-leaves finite
+  flag and `jnp.where`-selects the OLD params/opt-state when gradients are
+  nonfinite — the update is skipped the same step, in-graph, mirroring fp16
+  loss-scaler skip semantics (the reference's fp16 `overflow` path; bf16
+  needs no loss scale but still deserves the skip). The host-offload path
+  (`optim/offload.py`, `skip_nonfinite`) does the same from the already-
+  computed global norm. `halt_on_nonfinite` escalates a skip to a
+  `NonfiniteHaltError` that the trainer turns into a final checkpoint
+  (the PR 2 commit path) + nonzero exit, so a supervisor's crash-loop
+  budget sees a short, clean abort instead of hours of NaN steps.
+- **Host-side anomaly detection** (`AnomalyDetector`, `NumericsMonitor`):
+  rolling-window z-scores on loss and global grad norm. Every step appends
+  one record to `<output_dir>/numerics.jsonl` (process 0, next to
+  spans.jsonl); an anomaly additionally emits a `numerics_anomaly` span
+  into the PR 1 trace stream and dumps the full per-layer snapshot to
+  `numerics-snapshot-<step>.json`. Counters (`nonfinite_steps`,
+  `anomaly_count`) surface on the metrics line and in health.json.
+
+`tools/numerics_report.py` renders the offline view: per-stage norm
+trajectories, the anomaly timeline, and first-nonfinite localization to a
+stage/layer-group.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import math
+import os
+import time
+from typing import Any
+
+from llama_pipeline_parallel_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# stats fields whose per-step jsonl record keeps the full per-stage vector;
+# everything else in the device stats tree is snapshot-only detail
+PER_STAGE_FIELDS = ("grad_norm_per_stage", "param_norm_per_stage",
+                    "update_norm_per_stage", "act_rms_per_stage",
+                    "act_absmax_per_stage")
+
+
+class NonfiniteHaltError(RuntimeError):
+    """Raised by the monitor when `halt_on_nonfinite` is set and a step's
+    gradients were nonfinite. Carries the step so the trainer can cut a
+    final checkpoint (the update was skipped, so the saved state is the
+    last finite one) before exiting nonzero."""
+
+    def __init__(self, step: int, detail: str = ""):
+        super().__init__(
+            f"nonfinite gradients at step {step}{': ' + detail if detail else ''}"
+            f" — halting (numerics.halt_on_nonfinite)")
+        self.step = step
+
+
+@dataclasses.dataclass(frozen=True)
+class NumericsConfig:
+    """The `numerics.*` config node (docs/OBSERVABILITY.md)."""
+
+    enabled: bool = True
+    # rolling z-score detector: window of recent finite samples, threshold,
+    # and the minimum history before any z-score verdict is trusted (early
+    # training is legitimately volatile)
+    window: int = 50
+    zscore: float = 6.0
+    min_history: int = 8
+    # escalate a nonfinite-grad skip to checkpoint-and-exit-nonzero
+    halt_on_nonfinite: bool = False
+    # dump the per-layer snapshot json on every anomaly
+    snapshot_on_anomaly: bool = True
+
+    @classmethod
+    def from_cfg(cls, node: dict | None) -> "NumericsConfig":
+        node = dict(node or {})
+        unknown = set(node) - {f.name for f in dataclasses.fields(cls)}
+        if unknown:
+            raise ValueError(f"unknown numerics config keys {sorted(unknown)}; "
+                             f"known: {sorted(f.name for f in dataclasses.fields(cls))}")
+        return cls(**node)
+
+
+# ---------------------------------------------------------------------------
+# In-graph statistics (called inside the jitted step)
+# ---------------------------------------------------------------------------
+
+def _stage_sumsq(tree: Any):
+    """Sum of squares per stage over a stage-stacked subtree: every leaf is
+    [S, ...]; reduce all trailing axes, add across leaves -> [S] fp32."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree.leaves(tree)
+    return sum(jnp.sum(jnp.square(x.astype(jnp.float32)),
+                       axis=tuple(range(1, x.ndim))) for x in leaves)
+
+
+def _tree_finite(tree: Any):
+    """Scalar bool: every element of every leaf is finite."""
+    import jax
+    import jax.numpy as jnp
+
+    flags = [jnp.all(jnp.isfinite(x)) for x in jax.tree.leaves(tree)
+             if jnp.issubdtype(x.dtype, jnp.floating)]
+    out = flags[0]
+    for f in flags[1:]:
+        out = out & f
+    return out
+
+
+def _group_absmax(layers: Any) -> dict:
+    """abs-max per layer-group of the stacked layers subtree, keeping the
+    stage axis: {"attn.wq": [S], ...}. Group names follow the tree paths."""
+    import jax
+    import jax.numpy as jnp
+
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(layers)[0]:
+        name = ".".join(str(getattr(k, "key", k)) for k in path)
+        out[name] = jnp.max(jnp.abs(leaf.astype(jnp.float32)),
+                            axis=tuple(range(1, leaf.ndim)))
+    return out
+
+
+def _layer_absmax(layers: Any):
+    """[S, k] grad abs-max across all layer leaves — the per-layer-slot
+    localization grid the anomaly snapshot dumps."""
+    import jax
+    import jax.numpy as jnp
+
+    grids = [jnp.max(jnp.abs(x.astype(jnp.float32)),
+                     axis=tuple(range(2, x.ndim)))
+             for x in jax.tree.leaves(layers)]
+    out = grids[0]
+    for g in grids[1:]:
+        out = jnp.maximum(out, g)
+    return out
+
+
+def step_stats(params: Any, grads: Any, updates: Any | None = None) -> dict:
+    """Per-stage / per-layer-group statistics of one step, computed in-graph.
+
+    `params`/`grads` (and optionally `updates`) are the stage-stacked trees
+    (layer leaves [S, k, ...]); all reductions preserve the leading stage
+    axis, so every output is an [S] vector, an [S, k] grid, or a scalar —
+    a few hundred floats total, fetched asynchronously by the monitor.
+
+    Non-stacked leaves (embed/norm/lm_head) have no stage axis; they get
+    scalar absmax entries under `replicated_groups` (the pipeline places
+    them on the first/last stage, but their gradients are psum'd across pp
+    so a stage attribution would be fiction).
+    """
+    import jax.numpy as jnp
+
+    stats = {
+        "grad_norm_per_stage": jnp.sqrt(_stage_sumsq(grads["layers"])),
+        "param_norm_per_stage": jnp.sqrt(_stage_sumsq(params["layers"])),
+        "grad_absmax_per_group": _group_absmax(grads["layers"]),
+        "grad_absmax_per_layer": _layer_absmax(grads["layers"]),
+        "replicated_groups": {
+            key: jnp.max(jnp.abs(jnp.asarray(
+                grads[key]["embedding"] if key == "embed" else grads[key]
+            ).astype(jnp.float32)))
+            for key in ("embed", "norm", "lm_head")
+        },
+        "nonfinite": ~_tree_finite(grads),
+    }
+    if updates is not None:
+        stats["update_norm_per_stage"] = jnp.sqrt(_stage_sumsq(updates["layers"]))
+    return stats
+
+
+def poison_mask(num_stages: int, stage):
+    """[S] multiplier: +inf at `stage`, 1.0 elsewhere (stage == -1 -> all
+    ones). Multiplying one stage's gradients by it manufactures the exact
+    failure the observatory exists to catch — nonfinite values born in one
+    pipeline stage — at a chosen, reproducible step (the `grad_nonfinite`
+    fault op, utils/faults.py)."""
+    import jax.numpy as jnp
+
+    return jnp.where(jnp.arange(num_stages) == stage,
+                     jnp.float32(float("inf")), jnp.float32(1.0))
+
+
+def poison_grads(grads: Any, stage) -> Any:
+    """Scale the stacked layer gradients of one stage to +-inf/nan (zeros
+    become nan via inf*0 — still nonfinite, which is the point)."""
+    import jax
+
+    out = dict(grads)
+    out["layers"] = jax.tree.map(
+        lambda g: g * poison_mask(g.shape[0], stage).reshape(
+            (g.shape[0],) + (1,) * (g.ndim - 1)).astype(g.dtype),
+        grads["layers"])
+    return out
+
+
+def fault_stage(verdict: str | None) -> int:
+    """Parse a faults.fire() step-site verdict into the stage to poison
+    (-1 = no poison this step)."""
+    if verdict and verdict.startswith("grad_nonfinite"):
+        _, _, stage = verdict.partition(":")
+        return int(stage or 0)
+    return -1
+
+
+# ---------------------------------------------------------------------------
+# Host-side anomaly detection
+# ---------------------------------------------------------------------------
+
+class AnomalyDetector:
+    """Rolling-window z-score on one scalar series.
+
+    `push(x)` returns the z-score of x against the PREVIOUS window when the
+    detector has enough history, else None; the sample then joins the window
+    only if finite (a NaN loss must not poison the baseline that flags the
+    next spike). Degenerate windows (near-zero std early in training when
+    the series is flat) are floored so a microscopic wiggle is not a
+    6-sigma event."""
+
+    def __init__(self, window: int, min_history: int):
+        self._buf: collections.deque = collections.deque(maxlen=max(window, 2))
+        self._min = max(min_history, 2)
+
+    def push(self, x: float) -> float | None:
+        z = None
+        if math.isfinite(x) and len(self._buf) >= self._min:
+            n = len(self._buf)
+            mean = sum(self._buf) / n
+            var = sum((v - mean) ** 2 for v in self._buf) / n
+            std = max(math.sqrt(var), 1e-6 * max(abs(mean), 1.0), 1e-12)
+            z = (x - mean) / std
+        if math.isfinite(x):
+            self._buf.append(x)
+        return z
+
+
+def _to_py(x: Any) -> Any:
+    """Device array / numpy -> plain python (lists/floats/bools) for json.
+    Nonfinite floats become the strings json.dumps would reject."""
+    import numpy as np
+
+    arr = np.asarray(x)
+    if arr.ndim == 0:
+        v = arr.item()
+        if isinstance(v, float) and not math.isfinite(v):
+            return "inf" if v > 0 else ("-inf" if v < 0 else "nan")
+        return v
+    return [_to_py(v) for v in arr]
+
+
+class NumericsMonitor:
+    """The host half of the observatory: async stat fetch, per-step
+    numerics.jsonl records, anomaly spans/snapshots, nonfinite accounting.
+
+    `observe(step, loss, grad_norm, stats)` enqueues the step's DEVICE
+    arrays after starting their D2H copies (`copy_to_host_async`) and then
+    processes the PREVIOUS step's entry — whose transfer has long landed —
+    so the hot loop never blocks on the current step's result. `flush()`
+    drains the last pending entry at loop exit.
+
+    Every process runs a monitor (the stats are replicated, so detection —
+    and a `halt_on_nonfinite` raise — happens pod-uniformly at the same
+    step); only `write=True` (process 0) persists jsonl/snapshots.
+    `health_fields` is a live dict handed to the Heartbeat as `extra`, so
+    health.json always carries the current counters.
+    """
+
+    def __init__(self, output_dir: str, cfg: NumericsConfig,
+                 write: bool = True, recorder: Any = None):
+        self.cfg = cfg
+        self._dir = output_dir
+        self._recorder = recorder
+        self._f = None
+        if write:
+            os.makedirs(output_dir, exist_ok=True)
+            self._f = open(os.path.join(output_dir, "numerics.jsonl"), "a",
+                           buffering=1)
+        self._pending: collections.deque = collections.deque()
+        self._loss_det = AnomalyDetector(cfg.window, cfg.min_history)
+        self._grad_det = AnomalyDetector(cfg.window, cfg.min_history)
+        self.nonfinite_steps = 0
+        self.anomaly_count = 0
+        self.health_fields: dict[str, Any] = {
+            "nonfinite_steps": 0, "anomaly_count": 0, "grad_norm": None}
+
+    # -- the per-step path -------------------------------------------------
+
+    def observe(self, step: int, loss: Any, grad_norm: Any,
+                stats: dict | None) -> None:
+        """Enqueue this step's device values (async D2H) and process the
+        previous step's. May raise NonfiniteHaltError (from the PREVIOUS
+        step's record) when halt_on_nonfinite is configured."""
+        import jax
+
+        entry = (step, loss, grad_norm, stats)
+        for leaf in jax.tree.leaves((loss, grad_norm, stats)):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        self._pending.append(entry)
+        while len(self._pending) > 1:
+            self._process(self._pending.popleft())
+
+    def flush(self) -> None:
+        """Drain pending entries (end of loop / before a final save). Raises
+        like observe()."""
+        while self._pending:
+            self._process(self._pending.popleft())
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def scalars(self) -> dict[str, Any]:
+        """Counters for the metrics line."""
+        return {"nonfinite_steps": self.nonfinite_steps,
+                "anomaly_count": self.anomaly_count}
+
+    # -- record construction ----------------------------------------------
+
+    def _process(self, entry: tuple) -> None:
+        step, loss, grad_norm, stats = entry
+        loss = float(_np_scalar(loss))
+        grad_norm = None if grad_norm is None else float(_np_scalar(grad_norm))
+        rec: dict[str, Any] = {"step": step, "ts": time.time(),
+                               "loss": _finite_or_str(loss),
+                               "grad_norm": _finite_or_str(grad_norm)}
+        nonfinite = False
+        host_stats: dict | None = None
+        if stats is not None:
+            host_stats = {k: _to_py(v) for k, v in stats.items()
+                          if k not in ("grad_absmax_per_group",
+                                       "grad_absmax_per_layer",
+                                       "replicated_groups")}
+            nonfinite = bool(host_stats.pop("nonfinite", False))
+            for key in PER_STAGE_FIELDS:
+                if key in host_stats:
+                    rec[key] = host_stats[key]
+            if ("update_norm_per_stage" in rec
+                    and "param_norm_per_stage" in rec):
+                rec["update_ratio_per_stage"] = [
+                    _finite_or_str(u / p if p else 0.0)
+                    for u, p in zip(
+                        [stat_to_float(v) for v in rec["update_norm_per_stage"]],
+                        [stat_to_float(v) for v in rec["param_norm_per_stage"]])]
+        if grad_norm is not None and not math.isfinite(grad_norm):
+            nonfinite = True
+        rec["nonfinite"] = nonfinite
+
+        z_loss = self._loss_det.push(loss)
+        z_grad = (self._grad_det.push(grad_norm)
+                  if grad_norm is not None else None)
+        kinds = []
+        if nonfinite:
+            kinds.append("nonfinite")
+        if z_loss is not None and abs(z_loss) > self.cfg.zscore:
+            kinds.append("loss_spike")
+        if z_grad is not None and abs(z_grad) > self.cfg.zscore:
+            kinds.append("grad_spike")
+        if z_loss is not None:
+            rec["z_loss"] = round(z_loss, 3)
+        if z_grad is not None:
+            rec["z_grad"] = round(z_grad, 3)
+        if kinds:
+            rec["anomaly"] = kinds
+            self.anomaly_count += 1
+        if nonfinite:
+            self.nonfinite_steps += 1
+        self.health_fields.update(nonfinite_steps=self.nonfinite_steps,
+                                  anomaly_count=self.anomaly_count,
+                                  grad_norm=_finite_or_str(grad_norm))
+
+        if self._f is not None:
+            self._f.write(json.dumps(rec) + "\n")
+        if kinds:
+            self._on_anomaly(step, rec, stats, kinds)
+        if nonfinite and self.cfg.halt_on_nonfinite:
+            raise NonfiniteHaltError(step, detail=",".join(kinds))
+
+    def _on_anomaly(self, step: int, rec: dict, stats: dict | None,
+                    kinds: list) -> None:
+        logger.warning("numerics anomaly at step %d: %s (z_loss=%s z_grad=%s)",
+                       step, ",".join(kinds), rec.get("z_loss"),
+                       rec.get("z_grad"))
+        if self._recorder is not None:
+            # zero-duration marker span into the PR 1 trace stream: the
+            # anomaly lines up against data_wait/device_step on the same
+            # timeline (not a SPAN_BUCKETS name, so goodput is untouched)
+            self._recorder.emit("numerics_anomaly", time.time(), 0.0,
+                                step=step, kinds=kinds)
+        if self._f is not None and self.cfg.snapshot_on_anomaly and stats:
+            snap = {"step": step, "kinds": kinds, "record": rec,
+                    "grad_absmax_per_group":
+                        {k: _to_py(v) for k, v in
+                         stats.get("grad_absmax_per_group", {}).items()},
+                    "grad_absmax_per_layer":
+                        _to_py(stats["grad_absmax_per_layer"])
+                        if "grad_absmax_per_layer" in stats else None,
+                    "replicated_groups":
+                        {k: _to_py(v) for k, v in
+                         stats.get("replicated_groups", {}).items()}}
+            path = os.path.join(self._dir, f"numerics-snapshot-{step}.json")
+            try:
+                with open(path, "w") as f:
+                    json.dump(snap, f, indent=2)
+            except OSError:  # a full disk must not kill training
+                logger.exception("could not write numerics snapshot %s", path)
+
+
+def _np_scalar(x: Any) -> float:
+    import numpy as np
+
+    return float(np.asarray(x))
+
+
+def stat_to_float(v: Any) -> float:
+    """Decode one numerics.jsonl stat value: the writer spells nonfinite
+    floats as 'inf'/'-inf'/'nan' (JSON has no representation for them —
+    see _finite_or_str, the one encode site this must mirror). The offline
+    tools (tools/numerics_report.py) share this decoder."""
+    if isinstance(v, str):
+        return {"inf": math.inf, "-inf": -math.inf}.get(v, math.nan)
+    return float(v)
+
+
+def _finite_or_str(v: float | None) -> Any:
+    if v is None:
+        return None
+    if isinstance(v, str) or math.isfinite(v):
+        return v
+    return "inf" if v > 0 else ("-inf" if v < 0 else "nan")

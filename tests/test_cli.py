@@ -89,3 +89,54 @@ def test_inspect_ckpt_tool(tmp_path, devices):
     assert "INCOMPLETE" in partial["checkpoint"]["status"]
     with pytest.raises(ValueError, match="not found"):
         inspect_ckpt.describe(str(tmp_path), step=50)
+
+
+def test_inspect_ckpt_verify(tmp_path, devices):
+    """--verify recomputes per-file sha256 against the meta.json digests:
+    OK on a clean commit; MISMATCH + missing-from-meta + missing-on-disk
+    each get their own verdict (and a nonzero exit) after tampering."""
+    import shutil
+
+    import jax
+    import jax.numpy as jnp
+
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
+    from llama_pipeline_parallel_tpu.models.llama import model as llama
+    from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+    from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+    from llama_pipeline_parallel_tpu.parallel.pipeline import stack_stages
+
+    import inspect_ckpt
+
+    cfg = LlamaConfig.tiny()
+    manifest = StageManifest.for_config(cfg, 2)
+    params = stack_stages(llama.init_params(jax.random.PRNGKey(0), cfg), manifest)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(3, params, manifest, cfg, blocking=True)
+
+    clean = inspect_ckpt.verify_digests(str(tmp_path), 3)
+    assert clean["status"] == "OK"
+    assert set(clean["counts"]) == {"OK"} and clean["counts"]["OK"] > 0
+
+    step_dir = mgr.step_dir(3)
+    victim = next(
+        os.path.join(dp, f) for dp, _, fs in os.walk(step_dir) for f in fs
+        if f != "meta.json")
+    with open(victim, "r+b") as f:
+        f.seek(0)
+        f.write(b"\xde\xad\xbe\xef")
+    with open(os.path.join(step_dir, "stray.bin"), "w") as f:
+        f.write("not part of the commit")
+
+    bad = inspect_ckpt.verify_digests(str(tmp_path), 3)
+    assert bad["status"] == "FAILED"
+    assert bad["counts"].get("MISMATCH", 0) >= 1
+    assert bad["counts"].get("missing-from-meta") == 1
+    rel = os.path.relpath(victim, step_dir).replace(os.sep, "/")
+    assert bad["files"][rel] == "MISMATCH"
+    assert bad["files"]["stray.bin"] == "missing-from-meta"
+    assert inspect_ckpt.main([str(tmp_path), "--step", "3", "--verify"]) == 1
+
+    os.remove(victim)
+    gone = inspect_ckpt.verify_digests(str(tmp_path), 3)
+    assert gone["files"][rel] == "missing-on-disk"

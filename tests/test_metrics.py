@@ -83,3 +83,64 @@ def test_param_count_matches_init():
     n_actual = sum(x.size for x in jax.tree.leaves(
         llama.init_params(jax.random.PRNGKey(0), cfg)))
     assert param_count(cfg) == n_actual
+
+
+def test_throughput_zero_length_window():
+    """read_and_reset immediately after construction (or a reset): no
+    division error, zero rates, no mfu/real-token noise from a 0/0."""
+    cfg = LlamaConfig.tiny()
+    meter = Throughput(cfg, seq_length=32, n_chips=2, peak_flops_per_chip=1e12)
+    out = meter.read_and_reset()
+    assert out["tokens_per_sec"] == 0.0
+    assert out["tokens_per_sec_per_chip"] == 0.0
+    assert out.get("mfu", 0.0) == 0.0
+    assert "real_tokens_per_sec" not in out
+    # and the meter still works after the empty window
+    meter.update(100)
+    assert meter.read_and_reset()["tokens_per_sec"] > 0
+
+
+def test_detect_chip_peak_flops_unknown_device_logs_once(monkeypatch, caplog):
+    """On an unlisted device kind (CPU here) the verdict is None and the
+    'MFU disabled' notice appears exactly once per device kind — repeated
+    meters must not spam the log."""
+    import logging
+
+    from llama_pipeline_parallel_tpu.utils import metrics as metrics_mod
+
+    monkeypatch.setattr(metrics_mod, "_PEAK_FLOPS_LOGGED", set())
+    # the package root logger is non-propagating (own stderr handler);
+    # caplog listens on the true root, so re-enable propagation here
+    monkeypatch.setattr(
+        logging.getLogger("llama_pipeline_parallel_tpu"), "propagate", True)
+    with caplog.at_level(logging.INFO,
+                         logger="llama_pipeline_parallel_tpu.utils.metrics"):
+        assert metrics_mod.detect_chip_peak_flops() is None
+        assert metrics_mod.detect_chip_peak_flops() is None
+    notices = [r for r in caplog.records if "MFU disabled" in r.getMessage()]
+    assert len(notices) == 1
+
+
+def test_metrics_writer_appends_past_partial_file(tmp_path):
+    """A pre-existing metrics.jsonl with a torn tail (crashed writer) must
+    not be clobbered: old complete lines survive, the torn line stays torn,
+    new lines append parseable — and the tolerant reader recovers exactly
+    the complete records."""
+    path = tmp_path / "metrics.jsonl"
+    path.write_text('{"step": 1, "loss": 3.0}\n{"step": 2, "lo')  # torn tail
+    w = MetricsWriter(str(tmp_path))
+    w.log(3, {"loss": 2.0})
+    w.close()
+
+    raw = path.read_text().splitlines()
+    assert json.loads(raw[0]) == {"step": 1, "loss": 3.0}
+    # the torn line absorbed the next write's prefix or stayed unparseable —
+    # either way the tolerant reader must keep every complete record
+    import goodput_report  # importable via conftest's tools/ path insert
+
+    recs = goodput_report.load_jsonl(str(path))
+    steps = [r["step"] for r in recs if isinstance(r, dict) and "step" in r]
+    assert 1 in steps  # pre-existing complete record survived the append
+    # every recovered record is complete (the torn line was dropped, not
+    # half-merged into a bogus record)
+    assert all("loss" in r for r in recs)

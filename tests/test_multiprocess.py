@@ -51,8 +51,18 @@ def run_workers(scenario: str, tmpdir: str, num_processes: int,
             stdout=log, stderr=subprocess.STDOUT, env=env, cwd=_REPO))
     def _fail(pid: int, why: str):
         logs[pid].seek(0)
+        tail = logs[pid].read()
+        # Some jax CPU builds refuse cross-process collectives outright
+        # ("Multiprocess computations aren't implemented on the CPU
+        # backend") — an environment limitation, not a repo regression:
+        # skip with the reason instead of failing the suite.
+        if "Multiprocess computations aren't implemented" in tail:
+            pytest.skip(
+                f"jax CPU backend in this environment does not implement "
+                f"multiprocess computations (worker {pid} of {scenario!r}); "
+                f"run on a backend with cross-process collectives")
         pytest.fail(f"worker {pid}/{num_processes} of {scenario!r} {why}:\n"
-                    f"{logs[pid].read()[-4000:]}")
+                    f"{tail[-4000:]}")
 
     try:
         # poll round-robin, not in pid order: the first worker to die (any

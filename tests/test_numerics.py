@@ -1,0 +1,459 @@
+"""Numerics observatory: in-graph stats, nonfinite guard, anomaly stream.
+
+Covers the ISSUE 3 acceptance contract end to end: an injected nonfinite
+gradient (the `grad_nonfinite` fault op) is detected the SAME step, the
+update is where-skipped in-graph, the anomaly lands in numerics.jsonl +
+health.json, and tools/numerics_report.py localizes it to the right
+pipeline stage — plus the steady-state guarantee that the stats are
+computed in-graph (no host callbacks in the lowered step, no extra step
+inputs beyond state/batch).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llama_pipeline_parallel_tpu.models.llama import model as llama
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+from llama_pipeline_parallel_tpu.optim import OptimizerConfig, make_optimizer
+from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+from llama_pipeline_parallel_tpu.parallel import train_step as ts
+from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
+from llama_pipeline_parallel_tpu.utils import faults, numerics
+
+
+# ---------------------------------------------------------------------------
+# Host-side units
+# ---------------------------------------------------------------------------
+
+def test_anomaly_detector_flags_spike_not_steady():
+    det = numerics.AnomalyDetector(window=16, min_history=4)
+    zs = [det.push(2.0 + 0.01 * (i % 3)) for i in range(10)]
+    assert all(z is None or abs(z) < 6.0 for z in zs)
+    z = det.push(50.0)
+    assert z is not None and z > 6.0
+
+
+def test_anomaly_detector_nan_does_not_poison_window():
+    det = numerics.AnomalyDetector(window=16, min_history=4)
+    for _ in range(6):
+        det.push(1.0)
+    det.push(float("nan"))  # must not enter the baseline
+    z = det.push(1.0)
+    assert z is not None and abs(z) < 1.0  # baseline still the steady 1.0s
+
+
+def test_config_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="unknown numerics config keys"):
+        numerics.NumericsConfig.from_cfg({"windw": 10})
+    cfg = numerics.NumericsConfig.from_cfg({"window": 5, "halt_on_nonfinite": True})
+    assert cfg.window == 5 and cfg.halt_on_nonfinite
+
+
+def test_monitor_counts_and_writes_jsonl(tmp_path):
+    cfg = numerics.NumericsConfig(window=8, min_history=2, zscore=5.0)
+    mon = numerics.NumericsMonitor(str(tmp_path), cfg)
+    for step in range(1, 8):
+        mon.observe(step, loss=2.0, grad_norm=1.0, stats=None)
+    mon.observe(8, loss=200.0, grad_norm=1000.0, stats=None)  # finite spike
+    mon.observe(9, loss=2.0, grad_norm=float("inf"), stats=None)  # nonfinite
+    mon.flush()
+    mon.close()
+    recs = [json.loads(l) for l in open(tmp_path / "numerics.jsonl")]
+    assert [r["step"] for r in recs] == list(range(1, 10))
+    assert not recs[7]["nonfinite"]
+    assert {"loss_spike", "grad_spike"} <= set(recs[7]["anomaly"])
+    assert recs[8]["nonfinite"] and recs[8]["anomaly"] == ["nonfinite"]
+    assert mon.nonfinite_steps == 1 and mon.anomaly_count == 2
+    assert mon.health_fields["nonfinite_steps"] == 1
+    assert mon.health_fields["grad_norm"] == "inf"
+
+
+def test_monitor_halt_on_nonfinite(tmp_path):
+    cfg = numerics.NumericsConfig(halt_on_nonfinite=True)
+    mon = numerics.NumericsMonitor(str(tmp_path), cfg)
+    mon.observe(1, loss=2.0, grad_norm=float("nan"), stats=None)
+    with pytest.raises(numerics.NonfiniteHaltError) as ei:
+        mon.flush()
+    assert ei.value.step == 1
+    mon.close()
+
+
+# ---------------------------------------------------------------------------
+# In-graph stats + the nonfinite guard
+# ---------------------------------------------------------------------------
+
+PP, DP = 2, 2
+
+
+@pytest.fixture(scope="module")
+def step_setup(devices):
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    mesh = make_mesh(MeshConfig(pp=PP, dp=DP))
+    manifest = StageManifest.for_config(cfg, PP)
+    pcfg = pl.PipelineConfig(num_stages=PP, num_microbatches=2)
+    tx, schedule = make_optimizer(OptimizerConfig(
+        learning_rate=1e-3, total_steps=10, warmup_steps=1))
+    params = ts.init_params_sharded(jax.random.PRNGKey(0), cfg, mesh, manifest)
+    state = ts.init_train_state(params, tx, mesh)
+    rng = np.random.RandomState(0)
+    batch = {
+        "input_ids": jnp.asarray(rng.randint(0, cfg.vocab_size, (4 * DP, 16))),
+        "attention_mask": jnp.ones((4 * DP, 16), jnp.int32),
+        "position_ids": jnp.broadcast_to(jnp.arange(16), (4 * DP, 16)),
+        "labels": jnp.asarray(rng.randint(0, cfg.vocab_size, (4 * DP, 16))),
+    }
+    return cfg, mesh, manifest, pcfg, tx, schedule, params, state, batch
+
+
+def _fresh_state(step_setup):
+    cfg, mesh, manifest, pcfg, tx, schedule, params, state, batch = step_setup
+    params = ts.init_params_sharded(jax.random.PRNGKey(0), cfg, mesh, manifest)
+    return ts.init_train_state(params, tx, mesh)
+
+
+def test_step_stats_shapes_and_values(step_setup):
+    cfg, mesh, manifest, pcfg, tx, schedule, params, state, batch = step_setup
+    step = ts.make_train_step(mesh, cfg, pcfg, tx, schedule, params,
+                              collect_stats=True)
+    state2 = _fresh_state(step_setup)
+    state2, _ = step(state2, batch)  # step 0: warmup lr=0 -> zero updates
+    new_state, metrics = step(state2, batch)
+    stats = metrics["numerics"]
+    for key in ("grad_norm_per_stage", "param_norm_per_stage",
+                "update_norm_per_stage", "act_rms_per_stage",
+                "act_absmax_per_stage"):
+        arr = np.asarray(stats[key])
+        assert arr.shape == (PP,), key
+        assert np.all(np.isfinite(arr)) and np.all(arr > 0), key
+    assert not bool(stats["nonfinite"])
+    assert np.asarray(stats["grad_absmax_per_layer"]).shape == (
+        PP, manifest.max_layers_per_stage)
+    assert set(stats["grad_absmax_per_group"]) == {
+        "attn.wq", "attn.wk", "attn.wv", "attn.wo",
+        "mlp.gate", "mlp.up", "mlp.down", "input_norm", "post_norm"}
+    assert set(stats["replicated_groups"]) == {"embed", "norm", "lm_head"}
+    # the per-stage grad norms must compose to the (clip-input) global norm
+    # over the layers subtree: sqrt(sum of per-stage squares) is a lower
+    # bound of the full-tree norm reported in metrics
+    layers_norm = float(np.sqrt((np.asarray(stats["grad_norm_per_stage"]) ** 2).sum()))
+    assert layers_norm <= float(metrics["grad_norm"]) + 1e-4
+
+
+def test_gpipe_schedule_collects_stats_too(step_setup):
+    cfg, mesh, manifest, pcfg, tx, schedule, params, state, batch = step_setup
+    import dataclasses
+
+    gpcfg = dataclasses.replace(pcfg, schedule="gpipe")
+    step = ts.make_train_step(mesh, cfg, gpcfg, tx, schedule, params,
+                              collect_stats=True)
+    _, metrics = step(_fresh_state(step_setup), batch)
+    stats = metrics["numerics"]
+    arr = np.asarray(stats["act_rms_per_stage"])
+    assert arr.shape == (PP,) and np.all(np.isfinite(arr)) and np.all(arr > 0)
+
+
+def test_nonfinite_guard_skips_update_same_step(step_setup):
+    """A poisoned stage makes that step's grads nonfinite; params and
+    optimizer state must come out bit-identical to the pre-step state, the
+    flag must say so, and the NEXT (clean) step must train normally."""
+    cfg, mesh, manifest, pcfg, tx, schedule, params, state, batch = step_setup
+    step = ts.make_train_step(mesh, cfg, pcfg, tx, schedule, params,
+                              collect_stats=True, poison=True)
+    state2 = _fresh_state(step_setup)
+    before = jax.tree.map(np.asarray, state2.params)
+    poisoned, metrics = step(state2, batch, 1)  # poison stage 1
+    stats = metrics["numerics"]
+    assert bool(stats["nonfinite"])
+    per_stage = np.asarray(stats["grad_norm_per_stage"])
+    assert np.isfinite(per_stage[0]) and not np.isfinite(per_stage[1])
+    after = jax.tree.map(np.asarray, poisoned.params)
+    jax.tree.map(np.testing.assert_array_equal, before, after)
+    assert int(poisoned.step) == 1  # step counter still advances
+
+    # the skip also preserved the optimizer's internal count (0), so the
+    # next clean step still sees the warmup lr=0 — two clean steps prove
+    # training resumes: the first re-arms the schedule, the second moves
+    clean, metrics2 = step(poisoned, batch, -1)  # -1 = no poison
+    assert not bool(metrics2["numerics"]["nonfinite"])
+    clean2, _ = step(clean, batch, -1)
+    changed = jax.tree.leaves(jax.tree.map(
+        lambda a, b: bool(np.any(a != b)), after,
+        jax.tree.map(np.asarray, clean2.params)))
+    assert any(changed)
+
+
+def test_stats_are_in_graph_no_callbacks(step_setup):
+    """The steady-state contract: numerics stats add device-side reductions
+    only — no host callbacks / infeed / outfeed in the lowered step, and no
+    change to the step's input signature (state, batch)."""
+    cfg, mesh, manifest, pcfg, tx, schedule, params, state, batch = step_setup
+    step = ts.make_train_step(mesh, cfg, pcfg, tx, schedule, params,
+                              collect_stats=True)
+    lowered = step.lower(state, batch)
+    text = lowered.as_text()
+    for marker in ("callback", "infeed", "outfeed", "SendToHost", "RecvFromHost"):
+        assert marker not in text, f"host round-trip marker {marker!r} in HLO"
+    # the only custom_calls allowed are GSPMD sharding annotations — any
+    # other target would be a host round-trip or an op the stats smuggled in
+    import re
+
+    targets = set(re.findall(r"custom_call @(\w+)", text))
+    assert targets <= {"Sharding", "SPMDFullToShardShape",
+                       "SPMDShardToFullShape"}, targets
+    # stats appear in the jaxpr's outputs (in-graph, not post-hoc)
+    jaxpr_text = str(jax.make_jaxpr(
+        lambda s, b: step(s, b), static_argnums=())(state, batch))
+    assert "isfinite" in jaxpr_text or "is_finite" in jaxpr_text
+
+
+def test_collect_stats_off_is_signature_compatible(step_setup):
+    """collect_stats=False keeps the pre-observatory contract: metrics
+    carries no numerics key and the update is NOT nonfinite-guarded."""
+    cfg, mesh, manifest, pcfg, tx, schedule, params, state, batch = step_setup
+    step = ts.make_train_step(mesh, cfg, pcfg, tx, schedule, params)
+    _, metrics = step(_fresh_state(step_setup), batch)
+    assert "numerics" not in metrics
+
+
+# ---------------------------------------------------------------------------
+# Offload-path skip
+# ---------------------------------------------------------------------------
+
+def test_host_offload_skip_nonfinite(devices):
+    from llama_pipeline_parallel_tpu.optim.offload import HostOffloadAdamW
+
+    ocfg = OptimizerConfig(learning_rate=1e-2, total_steps=10, warmup_steps=1)
+    host = HostOffloadAdamW(ocfg, skip_nonfinite=True, device_norm=False)
+    tree = {"w": jnp.ones((4, 4), jnp.float32)}
+    host.init(tree)
+    nan_grads = {"w": jnp.full((4, 4), jnp.nan)}
+    host.update(nan_grads)
+    assert host.last_nonfinite and host.nonfinite_count == 1
+    assert host.step_count == 0  # moments/step untouched
+    np.testing.assert_array_equal(
+        np.asarray(host.masters_tree()["w"]), np.ones((4, 4), np.float32))
+    host.update({"w": jnp.ones((4, 4), jnp.float32)})
+    assert not host.last_nonfinite and host.step_count == 1
+    # two clean steps: the first burns the warmup lr=0, the second moves
+    host.update({"w": jnp.ones((4, 4), jnp.float32)})
+    assert host.step_count == 2 and host.nonfinite_count == 1
+    assert not np.allclose(np.asarray(host.masters_tree()["w"]), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# The chaos e2e: inject -> detect -> skip -> record -> localize
+# ---------------------------------------------------------------------------
+
+def _tiny_cfg(tmp_path, **kw):
+    cfg = {
+        "output_dir": str(tmp_path / "out"),
+        "mesh": {"pp": 2, "dp": 2},
+        "model": {"preset": "tiny", "dtype": "float32"},
+        "dataset": {"synthetic": True, "seq_length": 16, "pseudo_dataset_len": 128},
+        "seed": 7,
+        "per_device_train_batch_size": 2,
+        "gradient_accumulation_steps": 2,
+        "max_steps": 4,
+        "learning_rate": 1e-3,
+        "warmup_steps": 1,
+        "logging_steps": 2,
+        "save_steps": 0,
+        "save_final": False,
+        "attention": "exact",
+    }
+    cfg.update(kw)
+    return cfg
+
+
+def test_chaos_grad_nonfinite_detect_skip_localize(tmp_path, devices):
+    """The ISSUE 3 acceptance scenario: a grad_nonfinite fault at step 2
+    (stage 1) is detected that same step, the update is skipped (training
+    continues finite), the anomaly is in numerics.jsonl AND health.json,
+    and numerics_report localizes it to stage 1."""
+    from llama_pipeline_parallel_tpu.train import run_training
+
+    cfg = _tiny_cfg(tmp_path, fault_plan={
+        "faults": [{"site": "step", "op": "grad_nonfinite",
+                    "at_step": 2, "stage": 1}]})
+    summary = run_training(cfg)
+    assert summary["final_step"] == 4
+    assert np.isfinite(summary["final_loss"])  # the skip held the line
+    out = cfg["output_dir"]
+
+    recs = {r["step"]: r for r in
+            (json.loads(l) for l in open(os.path.join(out, "numerics.jsonl")))}
+    assert set(recs) == {1, 2, 3, 4}
+    # loop step 2 logs as record step 3 (records are 1-based like metrics)
+    assert recs[3]["nonfinite"] and "nonfinite" in recs[3]["anomaly"]
+    assert not recs[2]["nonfinite"] and not recs[4]["nonfinite"]
+    per_stage = recs[3]["grad_norm_per_stage"]
+    assert per_stage[1] in ("inf", "nan") and isinstance(per_stage[0], float)
+    # the skipped update left the next step finite
+    assert isinstance(recs[4]["grad_norm"], float)
+
+    health = json.load(open(os.path.join(out, "health.json")))
+    assert health["nonfinite_steps"] == 1 and health["anomaly_count"] == 1
+
+    metrics = [json.loads(l) for l in open(os.path.join(out, "metrics.jsonl"))]
+    assert metrics[-1]["nonfinite_steps"] == 1
+    assert metrics[-1]["anomaly_count"] == 1
+
+    import numerics_report  # importable via conftest's tools/ path insert
+
+    rep = numerics_report.build_report(out)
+    assert rep["nonfinite_steps"] == 1
+    loc = rep["first_nonfinite"]
+    assert loc["step"] == 3 and loc["stages"] == [1]
+    assert any(g.startswith(("attn", "mlp")) for g in loc.get("groups", []))
+    # the anomaly snapshot was dumped
+    assert os.path.exists(os.path.join(out, "numerics-snapshot-3.json"))
+
+    # goodput_report folds the anomaly timeline in
+    import goodput_report
+
+    grep = goodput_report.build_report(out)
+    assert grep["numerics"]["nonfinite_steps"] == 1
+    assert grep["numerics"]["first_nonfinite_step"] == 3
+
+
+def test_halt_on_nonfinite_checkpoints_and_raises(tmp_path, devices):
+    """halt_on_nonfinite escalates the skip: the run raises out of
+    run_training (-> nonzero exit) AFTER committing a final checkpoint of
+    the last-finite state through the PR 2 path."""
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
+    from llama_pipeline_parallel_tpu.train import run_training
+
+    cfg = _tiny_cfg(
+        tmp_path,
+        numerics={"halt_on_nonfinite": True},
+        fault_plan={"faults": [{"site": "step", "op": "grad_nonfinite",
+                                "at_step": 2, "stage": 0}]})
+    with pytest.raises(numerics.NonfiniteHaltError):
+        run_training(cfg)
+    mgr = CheckpointManager(cfg["output_dir"])
+    step = mgr.latest_step()
+    # fault at loop step 2 -> record 3 is nonfinite; the lag-1 monitor
+    # raises during record 4's step, whose (clean) update is already in the
+    # live state — the checkpoint must carry THAT label, or a resume would
+    # re-apply batch 4 (the review-fixed off-by-one)
+    assert step == 4
+    mgr.verify(step)  # integrity-complete commit, not a torn save
+
+
+def test_grad_nonfinite_plan_requires_numerics(tmp_path, devices):
+    """A grad_nonfinite rule with the observatory disabled would poison
+    params with no guard/skip/record — rejected at config time."""
+    from llama_pipeline_parallel_tpu.train import run_training
+
+    cfg = _tiny_cfg(
+        tmp_path,
+        numerics={"enabled": False},
+        fault_plan={"faults": [{"site": "step", "op": "grad_nonfinite",
+                                "at_step": 1}]})
+    with pytest.raises(ValueError, match="numerics.enabled"):
+        run_training(cfg)
+
+
+def test_numerics_disabled_writes_no_stream(tmp_path, devices):
+    from llama_pipeline_parallel_tpu.train import run_training
+
+    cfg = _tiny_cfg(tmp_path, numerics={"enabled": False})
+    run_training(cfg)
+    assert not os.path.exists(os.path.join(cfg["output_dir"], "numerics.jsonl"))
+    metrics = [json.loads(l) for l in
+               open(os.path.join(cfg["output_dir"], "metrics.jsonl"))]
+    assert "nonfinite_steps" not in metrics[-1]
+
+
+def test_pipeline_stats_under_tp(devices):
+    """collect_stats composes with tensor parallelism: the stat reductions'
+    dp/sp/tp collectives stay stage-uniform and the [S] outputs are finite."""
+    from llama_pipeline_parallel_tpu.parallel.pipeline import (
+        make_pipeline_loss_and_grad,
+        stack_stages,
+    )
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    mesh = make_mesh(MeshConfig(pp=2, tp=2))
+    manifest = StageManifest.for_config(cfg, 2)
+    stacked = pl.stack_stages(llama.init_params(jax.random.PRNGKey(0), cfg),
+                              manifest)
+    pcfg = pl.PipelineConfig(num_stages=2, num_microbatches=2)
+    fn = jax.jit(make_pipeline_loss_and_grad(mesh, cfg, pcfg, stacked,
+                                             collect_stats=True))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(3, cfg.vocab_size, size=(2, 16)).astype(np.int32)
+    batch = {"input_ids": jnp.asarray(ids),
+             "attention_mask": jnp.ones((2, 16), jnp.int32),
+             "position_ids": jnp.broadcast_to(jnp.arange(16, dtype=jnp.int32),
+                                              (2, 16)),
+             "labels": jnp.asarray(ids)}
+    loss, grads, stats = fn(stacked, batch)
+    assert np.isfinite(float(loss))
+    for key in ("act_rms_per_stage", "act_absmax_per_stage"):
+        arr = np.asarray(stats[key])
+        assert arr.shape == (2,) and np.all(np.isfinite(arr)) and np.all(arr > 0)
+
+
+def test_grad_nonfinite_stage_out_of_range_rejected(tmp_path, devices):
+    """A poison stage past num_stages would be an all-ones mask — the drill
+    would 'pass' while exercising nothing. Rejected at config time."""
+    from llama_pipeline_parallel_tpu.train import run_training
+
+    cfg = _tiny_cfg(tmp_path, fault_plan={
+        "faults": [{"site": "step", "op": "grad_nonfinite",
+                    "at_step": 1, "stage": 7}]})
+    with pytest.raises(ValueError, match="out of range"):
+        run_training(cfg)
+
+
+def test_chaos_grad_nonfinite_offload_path(tmp_path, devices):
+    """The host-offload optimizer path: the poison forces the separate
+    stats dispatch, the nonfinite global norm skips the masters update
+    (HostOffloadAdamW.skip_nonfinite), and the stream records it."""
+    from llama_pipeline_parallel_tpu.train import run_training
+
+    cfg = _tiny_cfg(
+        tmp_path, optimizer_offload=True,
+        fault_plan={"faults": [{"site": "step", "op": "grad_nonfinite",
+                                "at_step": 2, "stage": 0}]})
+    summary = run_training(cfg)
+    assert summary["final_step"] == 4
+    assert np.isfinite(summary["final_loss"])  # the skip held the line
+    out = cfg["output_dir"]
+    recs = {r["step"]: r for r in
+            (json.loads(l) for l in open(os.path.join(out, "numerics.jsonl")))}
+    assert recs[3]["nonfinite"] and not recs[4]["nonfinite"]
+    assert recs[3]["grad_norm_per_stage"][0] in ("inf", "nan")
+    health = json.load(open(os.path.join(out, "health.json")))
+    assert health["nonfinite_steps"] == 1
+
+
+def test_numerics_report_dedups_incarnations(tmp_path):
+    """A resume re-runs steps past its checkpoint and appends fresh records
+    for them; the offline readers keep only the surviving timeline (last
+    record per step), so a recovered nonfinite step stops being reported."""
+    import goodput_report
+    import numerics_report
+
+    rows = [
+        {"step": 1, "loss": 1.0, "grad_norm": 1.0, "nonfinite": False},
+        {"step": 2, "loss": 9.9, "grad_norm": "inf", "nonfinite": True,
+         "anomaly": ["nonfinite"]},
+        # crash + resume from checkpoint-1: step 2 re-runs clean
+        {"step": 2, "loss": 1.1, "grad_norm": 1.0, "nonfinite": False},
+        {"step": 3, "loss": 1.0, "grad_norm": 1.0, "nonfinite": False},
+    ]
+    with open(tmp_path / "numerics.jsonl", "w") as f:
+        f.write("".join(json.dumps(r) + "\n" for r in rows))
+    rep = numerics_report.build_report(str(tmp_path))
+    assert rep["records"] == 3
+    assert rep["nonfinite_steps"] == 0 and rep["first_nonfinite"] is None
+    summary = goodput_report.numerics_summary(str(tmp_path))
+    assert summary["records"] == 3 and summary["nonfinite_steps"] == 0

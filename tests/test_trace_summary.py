@@ -42,3 +42,49 @@ def test_track_filter_and_missing_dir(trace_dir):
     assert not totals_none
     with pytest.raises(FileNotFoundError, match="trace.json.gz"):
         trace_summary.load_latest_trace(trace_dir + "-missing")
+
+
+def _fake_trace() -> dict:
+    return {"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "pid": 1, "name": "fusion.1", "dur": 120.0},
+        {"ph": "X", "pid": 1, "name": "fusion.1", "dur": 80.0},
+    ]}
+
+
+def test_uncompressed_trace_json_accepted(tmp_path, capsys):
+    """Hand-saved / exporter-written *.trace.json (no gzip) loads and
+    summarizes exactly like the gzipped capture."""
+    import json as _json
+
+    p = tmp_path / "plugins" / "profile" / "run1"
+    p.mkdir(parents=True)
+    (p / "host.trace.json").write_text(_json.dumps(_fake_trace()))
+    path, trace = trace_summary.load_latest_trace(str(tmp_path))
+    assert path.endswith("host.trace.json")
+    totals, op_dur, op_count = trace_summary.summarize(trace)
+    assert totals == {"/host:CPU": 200.0}
+    assert op_count["/host:CPU"]["fusion.1"] == 2
+    trace_summary.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "fusion.1" in out and "ms total" in out
+
+
+def test_empty_dir_is_a_readable_message(tmp_path):
+    """An empty/partial trace dir exits with a verdict, not a traceback."""
+    with pytest.raises(SystemExit) as ei:
+        trace_summary.main([str(tmp_path)])
+    assert "trace.json" in str(ei.value)
+
+
+def test_partial_capture_is_a_readable_message(tmp_path):
+    """A torn capture (killed mid-profile-window) exits with a pointer to
+    the bad file instead of a JSONDecodeError traceback."""
+    p = tmp_path / "plugins" / "profile" / "run1"
+    p.mkdir(parents=True)
+    (p / "torn.trace.json").write_text('{"traceEvents": [{"ph": "X", "du')
+    with pytest.raises(SystemExit) as ei:
+        trace_summary.load_latest_trace(str(tmp_path))
+    assert "torn.trace.json" in str(ei.value)
+    assert "partial capture" in str(ei.value)

@@ -160,7 +160,10 @@ def incarnation_summary(output_dir: str) -> dict | None:
     rows = [r for r in rows if isinstance(r, dict)]
     if not rows:
         return None
-    failed = [r for r in rows if r.get("outcome") not in ("clean", None)]
+    # supervisor_stopped = the supervisor itself was preempted and the child
+    # checkpointed + exited cleanly — productive time, not restart badput
+    failed = [r for r in rows
+              if r.get("outcome") not in ("clean", "supervisor_stopped", None)]
     return {
         "incarnations": len(rows),
         "restarts": max(len(rows) - 1, 0),
@@ -168,6 +171,33 @@ def incarnation_summary(output_dir: str) -> dict | None:
         "hangs": sum(1 for r in failed if r.get("outcome") == "hang"),
         "lost_seconds": sum(_num(r.get("duration_s")) or 0.0 for r in failed),
         "last_outcome": rows[-1].get("outcome"),
+    }
+
+
+def numerics_summary(output_dir: str, top: int = 5) -> dict | None:
+    """Roll-up of the numerics observatory's stream (numerics.jsonl, one row
+    per step — utils/numerics.py), or None when the run had numerics off.
+    Folds the anomaly timeline into the run report so a goodput dip can be
+    read next to the loss spike / nonfinite step that caused the restart."""
+    rows = [r for r in load_jsonl(os.path.join(output_dir, "numerics.jsonl"))
+            if isinstance(r, dict) and "step" in r]
+    if not rows:
+        return None
+    # last record per step: resumes re-run steps past their checkpoint and
+    # append a second record — only the surviving timeline counts
+    by_step: dict = {}
+    for r in rows:
+        by_step[r["step"]] = r
+    rows = [by_step[s] for s in sorted(by_step)]
+    anomalies = [r for r in rows if r.get("anomaly")]
+    nonfinite = [r for r in rows if r.get("nonfinite")]
+    return {
+        "records": len(rows),
+        "nonfinite_steps": len(nonfinite),
+        "anomaly_count": len(anomalies),
+        "first_nonfinite_step": nonfinite[0]["step"] if nonfinite else None,
+        "anomalies": [{"step": r["step"], "kinds": r["anomaly"]}
+                      for r in anomalies[:top]],
     }
 
 
@@ -187,6 +217,7 @@ def build_report(output_dir: str, top: int = 5) -> dict:
         "cumulative_goodput": _num(health.get("goodput")),
         "last_step": health.get("last_step"),
         "incarnations": incarnation_summary(output_dir),
+        "numerics": numerics_summary(output_dir, top),
         "slowest_windows": slowest_windows(spans, metrics, top),
         "stall_histogram": stall_histogram(spans, "data_wait"),
         "prefetch_stalls": {
@@ -215,6 +246,19 @@ def print_report(rep: dict) -> None:
               f"restart(s): {inc['crashes']} crash(es), {inc['hangs']} "
               f"hang(s); {inc['lost_seconds']:.1f} s lost to failed "
               f"incarnations; last outcome: {inc['last_outcome']}")
+
+    num = rep.get("numerics")
+    if num:
+        print(f"\n== numerics (anomaly timeline) ==\n"
+              f"  {num['records']} records: {num['nonfinite_steps']} "
+              f"nonfinite step(s), {num['anomaly_count']} anomaly(ies)"
+              + (f"; first nonfinite at step {num['first_nonfinite_step']}"
+                 if num["first_nonfinite_step"] is not None else ""))
+        for a in num["anomalies"]:
+            print(f"    step {a['step']:<6} {','.join(a['kinds'])}")
+        if num["anomaly_count"]:
+            print("  (details: python tools/numerics_report.py "
+                  f"{rep['output_dir']})")
 
     print(f"\n== time buckets: {wall:.2f} s wall ==")
     for name, secs in sorted(rep["buckets"].items(), key=lambda kv: -kv[1]):

@@ -1,12 +1,18 @@
 #!/usr/bin/env python
 """Inspect a checkpoint directory: steps, completeness, manifest, layout.
 
-Usage: python tools/inspect_ckpt.py <output_dir> [--step N]
+Usage: python tools/inspect_ckpt.py <output_dir> [--step N] [--verify]
 
 The operational counterpart of the reference's ad-hoc `ls` +
 `latest`-tag-reading workflow (reference convert2ckpt.py:76-77,
 trainer_base_ds_mp.py:452-455): answers "what can I resume from, under
 which topology, with which optimizer layout" without loading any arrays.
+
+`--verify` recomputes every file's sha256 against the digests the commit
+recorded in meta.json (docs/RESILIENCE.md integrity layer) and reports
+per-file status: OK, MISMATCH (bit rot / torn write), missing-on-disk
+(recorded but gone), or missing-from-meta (on disk but never recorded —
+a stray or post-commit write). Exits nonzero when anything is not OK.
 """
 
 from __future__ import annotations
@@ -15,6 +21,8 @@ import argparse
 import json
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def describe(root: str, step: int | None = None) -> dict:
@@ -67,13 +75,76 @@ def describe(root: str, step: int | None = None) -> dict:
     return out
 
 
-def main(argv: list[str] | None = None) -> None:
+def verify_digests(root: str, step: int) -> dict:
+    """Per-file sha256 status for one checkpoint against its meta.json
+    digests. Walks the step dir so files the commit never recorded
+    (missing-from-meta) surface too; meta.json itself is excluded (the
+    digests live inside it — it cannot record its own hash)."""
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import (
+        CheckpointManager,
+        _file_digest,
+    )
+
+    mgr = CheckpointManager(root)
+    if not mgr.is_complete(step):
+        return {"step": step, "status": "INCOMPLETE",
+                "detail": "no meta.json (interrupted save) — nothing to "
+                          "verify against"}
+    meta = mgr.load_meta(step)
+    integrity = meta.get("integrity") or {}
+    recorded: dict = integrity.get("files") or {}
+    if not recorded:
+        return {"step": step, "status": "NO_DIGESTS",
+                "detail": "meta.json carries no integrity digests "
+                          "(pre-integrity format, or LPT_CKPT_DIGESTS=0)"}
+    step_dir = mgr.step_dir(step)
+    on_disk = set()
+    for dirpath, _, files in os.walk(step_dir):
+        for name in files:
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, step_dir).replace(os.sep, "/")
+            if rel != "meta.json":
+                on_disk.add(rel)
+    files: dict[str, str] = {}
+    for rel, want in sorted(recorded.items()):
+        full = os.path.join(step_dir, rel)
+        if rel not in on_disk:
+            files[rel] = "missing-on-disk"
+        else:
+            files[rel] = "OK" if _file_digest(full) == want else "MISMATCH"
+    for rel in sorted(on_disk - set(recorded)):
+        files[rel] = "missing-from-meta"
+    counts: dict[str, int] = {}
+    for status in files.values():
+        counts[status] = counts.get(status, 0) + 1
+    return {"step": step, "algo": integrity.get("algo", "sha256"),
+            "status": "OK" if set(counts) == {"OK"} else "FAILED",
+            "counts": counts, "files": files}
+
+
+def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("root", help="checkpoint output_dir")
     p.add_argument("--step", type=int, default=None,
                    help="inspect a specific step (default: latest complete)")
+    p.add_argument("--verify", action="store_true",
+                   help="recompute per-file sha256 digests against meta.json "
+                        "and report OK/MISMATCH/missing per file")
     args = p.parse_args(argv)
-    print(json.dumps(describe(args.root, args.step), indent=2, default=str))
+    out = describe(args.root, args.step)
+    rc = 0
+    if args.verify:
+        step = (args.step if args.step is not None
+                else out.get("latest_complete_step"))
+        if step is None:
+            out["verify"] = {"status": "NO_CHECKPOINT",
+                             "detail": "no complete checkpoint to verify"}
+            rc = 1
+        else:
+            out["verify"] = verify_digests(args.root, step)
+            rc = 0 if out["verify"]["status"] == "OK" else 1
+    print(json.dumps(out, indent=2, default=str))
+    return rc
 
 
 if __name__ == "__main__":

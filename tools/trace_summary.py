@@ -24,14 +24,35 @@ import os
 
 
 def load_latest_trace(trace_dir: str) -> tuple[str, dict]:
-    paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
-                             recursive=True), key=os.path.getmtime)
+    """Newest capture under `trace_dir`, gzipped or plain (some exporters
+    and hand-saved Perfetto sessions write uncompressed *.trace.json).
+    A missing capture raises FileNotFoundError; an unreadable or torn one
+    (killed mid-capture) raises SystemExit with a readable message — the
+    CLI prints it instead of a traceback."""
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                  recursive=True)
+        + glob.glob(os.path.join(trace_dir, "**", "*.trace.json"),
+                    recursive=True),
+        key=os.path.getmtime)
     if not paths:
         raise FileNotFoundError(
-            f"no *.trace.json.gz under {trace_dir} (is this a jax.profiler "
-            f"output dir? expected plugins/profile/<ts>/*.trace.json.gz)")
-    with gzip.open(paths[-1], "rt") as f:
-        return paths[-1], json.load(f)
+            f"no *.trace.json.gz (or *.trace.json) under {trace_dir} (is "
+            f"this a jax.profiler output dir? expected "
+            f"plugins/profile/<ts>/*.trace.json.gz)")
+    path = paths[-1]
+    opener = gzip.open if path.endswith(".gz") else open
+    try:
+        with opener(path, "rt") as f:
+            trace = json.load(f)
+    except (OSError, ValueError) as e:
+        raise SystemExit(
+            f"could not parse trace capture {path}: {e}\n(partial capture "
+            f"from an interrupted profile window? delete it and re-capture)")
+    if not isinstance(trace, dict):
+        raise SystemExit(f"trace capture {path} is not a Chrome-trace JSON "
+                         f"object (got {type(trace).__name__})")
+    return path, trace
 
 
 def summarize(trace: dict, track_filter: str | None = None):
@@ -68,7 +89,11 @@ def main(argv: list[str] | None = None) -> None:
                    help="only tracks whose process name contains this")
     args = p.parse_args(argv)
 
-    path, trace = load_latest_trace(args.trace_dir)
+    try:
+        path, trace = load_latest_trace(args.trace_dir)
+    except FileNotFoundError as e:
+        # empty/wrong dir: a readable verdict, not a traceback
+        raise SystemExit(str(e))
     print(f"trace: {path}")
     track_total, op_dur, op_count = summarize(trace, args.track)
     if not track_total:
