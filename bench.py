@@ -69,6 +69,44 @@ def _watchdog(seconds: int, report):
     return timer
 
 
+def _probe_devices(timeout_s: float) -> str | None:
+    """Fail-fast accelerator probe: list devices and run one trivial op on a
+    worker thread, bounded by `timeout_s`. Returns an error string when the
+    backend is unreachable (probe wedged or raised), None when healthy.
+
+    A thread for the same reason as the watchdog: an unreachable TPU wedges
+    inside a blocking C call, where signal handlers never run. BENCH_r05
+    burned the full 900 s watchdog before reporting rc=2 — with this probe
+    the error JSON line is emitted within BENCH_PROBE_TIMEOUT_S instead."""
+    import threading
+
+    result: dict = {}
+
+    def probe():
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            devs = jax.devices()
+            if not devs:
+                result["error"] = "jax.devices() returned no devices"
+                return
+            # a real dispatch + value fetch: device enumeration can succeed
+            # while the runtime tunnel is already wedged
+            if float(jnp.asarray(1.0) + jnp.asarray(1.0)) != 2.0:
+                result["error"] = "device arithmetic returned garbage"
+        except Exception as e:
+            result["error"] = f"device probe failed: {e!r}"
+
+    t = threading.Thread(target=probe, daemon=True, name="bench-device-probe")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return (f"device probe did not respond within {timeout_s:.0f}s "
+                f"(TPU unreachable/wedged?)")
+    return result.get("error")
+
+
 def main() -> None:
     results: dict[str, dict] = {}  # name -> {"dt": s/step, "tokens_per_step": n}
     summary_ctx: dict = {}
@@ -115,6 +153,22 @@ def main() -> None:
     from __graft_entry__ import _bench_config, _honor_cpu_request
 
     _honor_cpu_request()  # JAX_PLATFORMS=cpu smoke runs (sitecustomize pins TPU)
+
+    # Up-front device probe: an unreachable TPU fails in seconds with the
+    # same error-JSON contract, instead of wedging the first compile until
+    # the 900 s watchdog fires (BENCH_r05).
+    probe_err = _probe_devices(float(os.environ.get("BENCH_PROBE_TIMEOUT_S",
+                                                    "60")))
+    if probe_err:
+        watchdog.cancel()
+        print(json.dumps({
+            "metric": "tokens_per_sec_per_chip", "value": 0.0,
+            "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "error": f"no usable accelerator: {probe_err}",
+        }), flush=True)
+        # the probe thread may still be wedged inside the runtime — a plain
+        # sys.exit would hang interpreter shutdown on it
+        os._exit(2)
     from llama_pipeline_parallel_tpu.models.llama import model as llama
     from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
     from llama_pipeline_parallel_tpu.ops.attention import attention

@@ -34,10 +34,33 @@ class StageManifest:
     # None -> even split (num_layers % num_stages must be 0). Otherwise one
     # count per stage, each >= 1, summing to num_layers.
     layer_counts: tuple | None = None
+    # Interleaved scheduling (schedule: interleaved_1f1b): each stage owns
+    # `virtual_stages` NON-CONTIGUOUS chunks of layers, assigned round-robin
+    # over global chunks — chunk c (of num_stages * virtual_stages equal
+    # chunks, in layer order) lives on stage c % num_stages as its virtual
+    # chunk c // num_stages, so the activation ring passes through every
+    # stage `virtual_stages` times per microbatch. 1 = the flat contiguous
+    # partition (every existing checkpoint/manifest deserializes to it).
+    virtual_stages: int = 1
 
     def __post_init__(self) -> None:
         if self.num_stages < 1:
             raise ValueError(f"num_stages must be >= 1, got {self.num_stages}")
+        if self.virtual_stages < 1:
+            raise ValueError(
+                f"virtual_stages must be >= 1, got {self.virtual_stages}")
+        if self.virtual_stages > 1:
+            if self.layer_counts is not None:
+                raise ValueError(
+                    "virtual_stages > 1 requires an even partition: the "
+                    "round-robin chunk assignment has no uneven form — drop "
+                    "layer_counts or set virtual_stages: 1")
+            denom = self.num_stages * self.virtual_stages
+            if self.num_layers % denom:
+                raise ValueError(
+                    f"num_layers={self.num_layers} not divisible by "
+                    f"num_stages*virtual_stages={denom}; interleaved "
+                    f"scheduling needs equal-size chunks")
         if self.layer_counts is None:
             if self.num_layers % self.num_stages:
                 raise ValueError(
@@ -83,6 +106,37 @@ class StageManifest:
         """Slot count of the padded stacked layout [num_stages, k_max, ...]."""
         return max(self.stage_layer_counts)
 
+    # -- interleaved (virtual_stages > 1) chunk geometry --------------------
+
+    @property
+    def layers_per_chunk(self) -> int:
+        """Layer count of one virtual chunk — the k of the interleaved
+        stacked layout [num_stages, virtual_stages, k, ...]."""
+        return self.num_layers // (self.num_stages * self.virtual_stages)
+
+    def chunk_of_layer(self, layer_idx: int) -> tuple:
+        """(stage, virtual_chunk) of a layer under the round-robin
+        assignment ((stage, 0) for every layer of a flat manifest's stage)."""
+        if not 0 <= layer_idx < self.num_layers:
+            raise ValueError(f"layer {layer_idx} out of range [0, {self.num_layers})")
+        if self.virtual_stages == 1:
+            return self.stage_of_layer(layer_idx), 0
+        c = layer_idx // self.layers_per_chunk
+        return c % self.num_stages, c // self.num_stages
+
+    def layers_of_chunk(self, stage: int, virtual_chunk: int) -> range:
+        """Layer range of one (stage, virtual_chunk) cell."""
+        if not 0 <= stage < self.num_stages:
+            raise ValueError(f"stage {stage} out of range [0, {self.num_stages})")
+        if not 0 <= virtual_chunk < self.virtual_stages:
+            raise ValueError(f"virtual chunk {virtual_chunk} out of range "
+                             f"[0, {self.virtual_stages})")
+        if self.virtual_stages == 1:
+            return self.layers_of_stage(stage)
+        k = self.layers_per_chunk
+        off = (virtual_chunk * self.num_stages + stage) * k
+        return range(off, off + k)
+
     # embed lives on the first stage, final norm + lm head on the last
     # (reference layer-list order, models/llama_ds_mp_wrap.py:213-219)
     embed_stage: int = 0
@@ -102,21 +156,32 @@ class StageManifest:
     def stage_of_layer(self, layer_idx: int) -> int:
         if not 0 <= layer_idx < self.num_layers:
             raise ValueError(f"layer {layer_idx} out of range [0, {self.num_layers})")
+        if self.virtual_stages > 1:
+            return (layer_idx // self.layers_per_chunk) % self.num_stages
         for s, (off, c) in enumerate(zip(self.stage_offsets(),
                                          self.stage_layer_counts)):
             if off <= layer_idx < off + c:
                 return s
         raise AssertionError("unreachable")
 
-    def layers_of_stage(self, stage: int) -> range:
+    def layers_of_stage(self, stage: int):
+        """Layer indices owned by one stage: a contiguous range for flat
+        manifests, the sorted union of the stage's virtual chunks (a list —
+        NON-contiguous) under interleaving."""
         if not 0 <= stage < self.num_stages:
             raise ValueError(f"stage {stage} out of range [0, {self.num_stages})")
+        if self.virtual_stages > 1:
+            return [layer for vc in range(self.virtual_stages)
+                    for layer in self.layers_of_chunk(stage, vc)]
         off = self.stage_offsets()[stage]
         return range(off, off + self.stage_layer_counts[stage])
 
     @staticmethod
-    def for_config(cfg: LlamaConfig, num_stages: int) -> "StageManifest":
-        return StageManifest(num_layers=cfg.num_hidden_layers, num_stages=num_stages)
+    def for_config(cfg: LlamaConfig, num_stages: int,
+                   virtual_stages: int = 1) -> "StageManifest":
+        return StageManifest(num_layers=cfg.num_hidden_layers,
+                             num_stages=num_stages,
+                             virtual_stages=virtual_stages)
 
     @staticmethod
     def balanced(cfg: LlamaConfig, num_stages: int,

@@ -86,6 +86,13 @@ class MeshConfig:
         return MeshConfig(pp=pp, dp=world_size // denom, tp=tp, sp=sp)
 
 
+# Layouts already warned about as under-using the device pool: one warning
+# per distinct (world_size, available, axes) layout per process — test
+# suites and dryrun sweeps build the same small mesh dozens of times, and
+# repeating the line every build buries real output (MULTICHIP_r05).
+_UNDERUSE_WARNED: set = set()
+
+
 def make_mesh(config: MeshConfig, devices: Sequence[jax.Device] | None = None) -> Mesh:
     """Build the `(pp, dp, sp, tp)` mesh over the available devices."""
     if devices is None:
@@ -97,11 +104,16 @@ def make_mesh(config: MeshConfig, devices: Sequence[jax.Device] | None = None) -
             f"but only {len(devices)} available"
         )
     if config.world_size < len(devices):
-        get_logger(__name__).warning(
-            "mesh uses %d of %d available devices (pp=%d dp=%d sp=%d tp=%d); "
-            "the rest stay idle",
-            config.world_size, len(devices), config.pp, config.dp, config.sp, config.tp,
-        )
+        layout = (config.world_size, len(devices),
+                  config.pp, config.dp, config.sp, config.tp)
+        if layout not in _UNDERUSE_WARNED:
+            _UNDERUSE_WARNED.add(layout)
+            get_logger(__name__).warning(
+                "mesh uses %d of %d available devices (pp=%d dp=%d sp=%d tp=%d); "
+                "the rest stay idle (warned once per layout)",
+                config.world_size, len(devices), config.pp, config.dp, config.sp,
+                config.tp,
+            )
     devices = list(devices)[: config.world_size]
     shape = (config.pp, config.dp, config.sp, config.tp)
     if len(devices) > 1 and devices[0].platform == "tpu":

@@ -13,13 +13,17 @@ Design (and why it is not a translation of DeepSpeed):
   (the analogue of `LayerSpec` lazy per-rank materialization, reference
   models/llama_ds_mp_wrap.py:209-224, but by sharding, not by construction
   order).
-- Two schedules, both skewed microbatch loops where activations hop to the
+- Three schedules, all skewed microbatch loops where activations hop to the
   next stage via `jax.lax.ppermute` over the ICI ring (the analogue of NCCL
   P2P send/recv):
   * "1f1b" (default) — the schedule DeepSpeed's engine runs: forward and
     backward interleave in one scan with a hand-written per-stage `jax.vjp`
     backward, bounding in-flight activations at min(2S-1, M) stage inputs
     (see `_pipeline_1f1b_local`).
+  * "interleaved_1f1b" — Megatron-style virtual pipeline stages: each stage
+    owns `virtual_stages` round-robin layer chunks, the activation laps the
+    ring v times per microbatch, and the flush bubble drops ~2vx
+    (see `_pipeline_interleaved_1f1b_local`; docs/SCHEDULES.md).
   * "gpipe" — forward-only scan; JAX autodiff yields the backward pipeline
     automatically (the transpose of `ppermute` is the reverse `ppermute`),
     at the cost of O(M) stored boundary activations.
@@ -76,7 +80,7 @@ Params = dict
 Batch = dict
 
 
-SCHEDULES = ("1f1b", "gpipe")
+SCHEDULES = ("1f1b", "interleaved_1f1b", "gpipe")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -100,9 +104,18 @@ class PipelineConfig:
     # regardless of M, with the single (num_stages-1)-tick flush bubble (the
     # schedule DeepSpeed's engine runs inside the reference's
     # `engine.train_batch`, trainer_base_ds_mp.py:354).
+    # "interleaved_1f1b": the same hand-written backward, but each stage owns
+    # `virtual_stages` round-robin layer chunks and the activation rides the
+    # pp ring v times per microbatch — the flush bubble drops from
+    # 2(S-1) full-stage ticks to (S-1) chunk-tick pairs, ~2vx smaller
+    # (docs/SCHEDULES.md), at the cost of v x the ring hops and a ring
+    # buffer of min(2vS-1, Mv) chunk inputs. Requires an even partition
+    # with num_layers % (S*v) == 0 and microbatches-per-flush % S == 0.
     # "gpipe": forward-only scan differentiated by AD — simpler graph, but
     # stores one stage-boundary activation per tick, so memory grows with M.
     schedule: str = "1f1b"
+    # Virtual pipeline chunks per stage (interleaved_1f1b only; 1 elsewhere).
+    virtual_stages: int = 1
     # Split the microbatches into this many sequential pipeline flushes within
     # ONE jitted step, at the price of one extra (num_stages-1)-tick bubble
     # per chunk. Under "gpipe" this is the only memory bound (chunks=8 at
@@ -152,6 +165,27 @@ class PipelineConfig:
             raise ValueError(
                 f"accum_chunks={self.accum_chunks} must divide "
                 f"num_microbatches={self.num_microbatches}")
+        if self.virtual_stages < 1:
+            raise ValueError(
+                f"virtual_stages must be >= 1, got {self.virtual_stages}")
+        if self.virtual_stages > 1 and self.schedule != "interleaved_1f1b":
+            raise ValueError(
+                f"virtual_stages={self.virtual_stages} requires "
+                f"schedule=interleaved_1f1b (got {self.schedule!r})")
+        if self.schedule == "interleaved_1f1b":
+            if self.layer_counts is not None and len(set(self.layer_counts)) != 1:
+                raise ValueError(
+                    "interleaved_1f1b requires an even stage partition; "
+                    f"got layer_counts={self.layer_counts}")
+            m_flush = self.num_microbatches // self.accum_chunks
+            if self.virtual_stages > 1 and m_flush % self.num_stages:
+                raise ValueError(
+                    f"interleaved_1f1b with virtual_stages="
+                    f"{self.virtual_stages} needs microbatches-per-flush "
+                    f"({self.num_microbatches}/{self.accum_chunks}="
+                    f"{m_flush}) divisible by num_stages={self.num_stages} "
+                    f"(the round-robin unit groups hold one microbatch per "
+                    f"stage)")
         if self.layer_counts is not None:
             object.__setattr__(self, "layer_counts",
                                tuple(int(c) for c in self.layer_counts))
@@ -168,7 +202,7 @@ def bubble_fraction(pcfg: PipelineConfig) -> float:
     without a profiler (the measured breakdown OptPipe/SkipPipe-style
     schedule work optimizes against — PAPERS.md).
 
-    Both schedules run S stages over M microbatches in `accum_chunks` (= c)
+    Every schedule runs S stages over M microbatches in `accum_chunks` (= c)
     sequential flushes of m = M/c microbatches, every tick the same cost
     across stages (in-jit scan: warmup/drain ticks take a full tick's wall
     time even where a stage's slot is masked):
@@ -176,6 +210,16 @@ def bubble_fraction(pcfg: PipelineConfig) -> float:
     - "1f1b": each flush scans m + 2(S-1) combined fwd+bwd ticks
       (`_pipeline_1f1b_local`'s num_ticks) of which m are useful per stage
       -> bubble = 2c(S-1) / (M + 2c(S-1)).
+    - "interleaved_1f1b": each flush runs m*v chunk-sized units per stage
+      (v = virtual_stages), phased as vS-1 forward-only warmup ticks +
+      mv + S - 1 - (vS-1) combined ticks + vS-1 backward-only drain ticks
+      (`_pipeline_interleaved_1f1b_local`). A warmup tick costs one chunk
+      FORWARD and a drain tick one chunk BACKWARD, so the two phases pair
+      into vS-1 full chunk ticks and the flush totals mv + S - 1 chunk-tick
+      equivalents, mv useful -> bubble = c(S-1) / (Mv + c(S-1)) —
+      independent of the fwd/bwd cost split, ~2vx below flat 1f1b for
+      m >> S (the v from the shorter fill, the 2 from warmup/drain ticks no
+      longer paying the masked opposite half).
     - "gpipe": the forward scan is m + S - 1 ticks and the AD transpose
       mirrors it, m useful each way
       -> bubble = c(S-1) / (M + c(S-1)).
@@ -184,12 +228,16 @@ def bubble_fraction(pcfg: PipelineConfig) -> float:
     if s <= 1:
         return 0.0
     m, c = pcfg.num_microbatches, pcfg.accum_chunks
+    if pcfg.schedule == "interleaved_1f1b":
+        mv = m * pcfg.virtual_stages
+        return (s - 1) * c / (mv + (s - 1) * c)
     per_flush = 2 * (s - 1) if pcfg.schedule == "1f1b" else (s - 1)
     return per_flush * c / (m + per_flush * c)
 
 
 # ---------------------------------------------------------------------------
 # Param layout: [n_layers, ...] <-> [num_stages, layers_per_stage, ...]
+# (or [num_stages, virtual_stages, layers_per_chunk, ...] under interleaving)
 # ---------------------------------------------------------------------------
 
 def _reshape_leaf(x, shape: tuple[int, ...]):
@@ -224,6 +272,59 @@ def _reshaped_sharding(x: jax.ShapeDtypeStruct, shape: tuple[int, ...]):
     return None
 
 
+def _interleaved_sharding(x, stacking: bool):
+    """Sharding carry for the interleaved stack/unstack: the round-robin
+    chunk gather reorders whole layer slices along the LEADING dim (stage
+    blocks are non-contiguous in canonical layer order), so leading-dim
+    sharding is inexpressible and drops to replicated, while trailing-dim
+    shardings survive verbatim — the same policy (and the same reason it is
+    load-bearing) as the uneven unstack path below."""
+    from jax.sharding import NamedSharding
+
+    src = getattr(x, "sharding", None)
+    if not isinstance(src, NamedSharding):
+        return None
+    spec = list(src.spec) + [None] * (len(x.shape) - len(src.spec))
+    if stacking:  # canonical [n, feat...] -> stacked [S, v, k, feat...]
+        lead, trailing = (None, None, None), spec[1:]
+    else:         # stacked [S, v, k, feat...] -> canonical [n, feat...]
+        lead, trailing = (None,), spec[3:]
+    return NamedSharding(src.mesh, P(*lead, *trailing))
+
+
+def _stack_interleaved(layers: Params, manifest: StageManifest) -> Params:
+    """Canonical [n, ...] -> [num_stages, virtual_stages, k, ...]: global
+    chunk c (layers [c*k, (c+1)*k)) lands at [c % S, c // S] — a pure
+    reshape + transpose, so the round trip is bit-exact by construction."""
+    s, v, k = (manifest.num_stages, manifest.virtual_stages,
+               manifest.layers_per_chunk)
+
+    def leaf(x):
+        shape = (s, v, k) + tuple(x.shape[1:])
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return jax.ShapeDtypeStruct(
+                shape, x.dtype, sharding=_interleaved_sharding(x, stacking=True))
+        y = jnp.asarray(x).reshape((v, s, k) + tuple(x.shape[1:]))
+        return jnp.moveaxis(y, 0, 1)
+
+    return jax.tree.map(leaf, layers)
+
+
+def _unstack_interleaved(layers: Params, manifest: StageManifest) -> Params:
+    n = manifest.num_layers
+    s, v, k = (manifest.num_stages, manifest.virtual_stages,
+               manifest.layers_per_chunk)
+
+    def leaf(x):
+        shape = (n,) + tuple(x.shape[3:])
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return jax.ShapeDtypeStruct(
+                shape, x.dtype, sharding=_interleaved_sharding(x, stacking=False))
+        return jnp.moveaxis(jnp.asarray(x), 1, 0).reshape(shape)
+
+    return jax.tree.map(leaf, layers)
+
+
 def stack_stages(params: Params, manifest: StageManifest) -> Params:
     """Canonical [n_layers, ...] -> stacked [num_stages, k_max, ...] leaves,
     exposing the stage axis for pp sharding.
@@ -232,8 +333,17 @@ def stack_stages(params: Params, manifest: StageManifest) -> Params:
     layers into its first `layer_counts[s]` slots and ZERO the padding slots —
     an all-zero residual block is an exact identity with identically zero
     gradients (see manifest.py), so the padded layout is correct by
-    construction."""
+    construction. Interleaved manifests (virtual_stages > 1) grow a
+    virtual-chunk axis ahead of the layer-slot axis —
+    [num_stages, virtual_stages, k, ...] — via the round-robin chunk
+    assignment (see _stack_interleaved); the canonical checkpoint layout is
+    unchanged, so PR-2 checkpoints and the HF converter restore into any
+    schedule's layout through this one pair of functions."""
     s, k = manifest.num_stages, manifest.max_layers_per_stage
+    if manifest.virtual_stages > 1:
+        out = dict(params)
+        out["layers"] = _stack_interleaved(params["layers"], manifest)
+        return out
     if manifest.is_even:
         out = dict(params)
         out["layers"] = jax.tree.map(
@@ -263,6 +373,10 @@ def stack_stages(params: Params, manifest: StageManifest) -> Params:
 def unstack_stages(params: Params, manifest: StageManifest) -> Params:
     n = manifest.num_layers
     s, k = manifest.num_stages, manifest.max_layers_per_stage
+    if manifest.virtual_stages > 1:
+        out = dict(params)
+        out["layers"] = _unstack_interleaved(params["layers"], manifest)
+        return out
     if manifest.is_even:
         out = dict(params)
         out["layers"] = jax.tree.map(
@@ -314,7 +428,12 @@ def stage_param_specs(params: Params, tp: bool = False) -> Params:
     specs = jax.tree.map(lambda _: P(), params)
     specs["layers"] = jax.tree.map(lambda _: P(AXIS_PP), params["layers"])
     if tp:
-        col, row = P(AXIS_PP, None, None, AXIS_TP), P(AXIS_PP, None, AXIS_TP, None)
+        # matmul leaves are [S, k, in, out] flat or [S, v, k, in, out]
+        # interleaved — place tp by counting from the TRAILING (matmul) dims
+        # so both stacked layouts shard identically
+        nd = len(params["layers"]["attn"]["wq"].shape)
+        col = P(AXIS_PP, *([None] * (nd - 3)), None, AXIS_TP)
+        row = P(AXIS_PP, *([None] * (nd - 3)), AXIS_TP, None)
         specs["layers"]["attn"] = {"wq": col, "wk": col, "wv": col, "wo": row}
         specs["layers"]["mlp"] = {"gate": col, "up": col, "down": row}
         specs["lm_head"] = P(None, AXIS_TP)
@@ -462,6 +581,105 @@ def _act_stat_update(carry: tuple, y: jnp.ndarray, valid) -> tuple:
 _ACT_STATS_ZERO = lambda: (jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0))
 
 
+def _act_stats_zero_chunks(v: int):
+    """Per-virtual-chunk accumulators ([v] each) for the interleaved
+    schedule; folds elementwise exactly like the scalar flat-schedule ones."""
+    z = jnp.zeros((v,), jnp.float32)
+    return (z, z, z)
+
+
+def _act_stat_update_chunk(carry: tuple, y: jnp.ndarray, valid, ch, v: int
+                           ) -> tuple:
+    """Fold one tick's chunk-boundary activation into the [v]-shaped
+    accumulators at virtual-chunk index `ch` (traced)."""
+    absmax, msq_sum, n = carry
+    yf = jax.lax.stop_gradient(y).astype(jnp.float32)
+    onehot = (jnp.arange(v) == ch) & valid
+    absmax = jnp.maximum(absmax, jnp.where(onehot, jnp.max(jnp.abs(yf)), 0.0))
+    msq_sum = msq_sum + jnp.where(onehot, jnp.mean(jnp.square(yf)), 0.0)
+    return absmax, msq_sum, n + onehot.astype(jnp.float32)
+
+
+def _sched_act_stats_zero(pcfg: PipelineConfig):
+    """Schedule-appropriate zero activation-stat carry (shapes must agree
+    across the accum_chunks fold)."""
+    if pcfg.schedule == "interleaved_1f1b":
+        return _act_stats_zero_chunks(pcfg.virtual_stages)
+    return _ACT_STATS_ZERO()
+
+
+# ---------------------------------------------------------------------------
+# Interleaved unit indexing (schedule: interleaved_1f1b)
+#
+# One scheduling UNIT is one (microbatch, virtual-chunk) pair — a microbatch
+# passing through one stage's chunk of layers. Units are ordered in groups
+# of v*S: group g covers microbatches [g*S, (g+1)*S) through all v chunks,
+# chunk-major — so unit u and unit u+S are the SAME microbatch on the NEXT
+# chunk, which is exactly one lap of the pp ring later. That makes the
+# plain (i -> i+1) ring ppermute carry BOTH the stage->stage handoff and the
+# last-stage -> first-stage chunk transition, with no special cases (and its
+# reverse do the same for cotangents). Requires m % S == 0 per flush
+# (validated in PipelineConfig).
+# ---------------------------------------------------------------------------
+
+def _unit_mb_chunk(u, s: int, v: int):
+    """Forward unit index -> (microbatch, virtual chunk)."""
+    grp = u // (v * s)
+    return grp * s + u % s, (u // s) % v
+
+
+def _bwd_unit_mb_chunk(g, s: int, v: int):
+    """Backward unit index -> (microbatch, virtual chunk): same group/slot
+    layout with the CHUNK order reversed — backward starts at the last
+    chunk (the loss end of the virtual pipeline) and descends."""
+    grp = g // (v * s)
+    return grp * s + g % s, v - 1 - (g // s) % v
+
+
+def _mb_streams(batch: Batch, cfg: LlamaConfig, pcfg: PipelineConfig):
+    """Per-microbatch data access shared by the schedule loops (runs INSIDE
+    shard_map). Returns (mb_rows, seqlen, mb_data) where `mb_data(idx)` ->
+    (ids, pad_mask, cos, sin, targets) of microbatch `idx`.
+
+    Labels are pre-shifted to next-token targets ONCE for the whole chunk
+    (microbatch slicing is over the batch dim, so it commutes with the
+    sequence-dim shift): under sp the shift is a collective, and hoisting it
+    here keeps it off the schedules' per-tick critical path AND
+    stage-uniform."""
+    m_total = pcfg.num_microbatches
+    ids = batch["input_ids"]
+    bsz, seqlen = ids.shape
+    if bsz % m_total:
+        raise ValueError(f"per-dp batch {bsz} not divisible by microbatches {m_total}")
+    mb = bsz // m_total
+    sp_size = compat.axis_size(AXIS_SP)
+    # seqlen here is the LOCAL slab length; fallback positions must be global
+    sp_pos_base = jax.lax.axis_index(AXIS_SP) * seqlen if sp_size > 1 else 0
+
+    def mb_view(x):
+        return x.reshape((m_total, mb) + x.shape[1:])
+
+    ids_m = mb_view(ids)
+    mask_m = mb_view(batch["attention_mask"]) if batch.get("attention_mask") is not None else None
+    pos_m = mb_view(batch["position_ids"]) if batch.get("position_ids") is not None else None
+    targets_m = mb_view(_sp_shift_labels(batch["labels"], sp_size))
+
+    def mb_data(idx):
+        my_ids = jax.lax.dynamic_index_in_dim(ids_m, idx, keepdims=False)
+        if pos_m is not None:
+            pos = jax.lax.dynamic_index_in_dim(pos_m, idx, keepdims=False)
+        else:
+            pos = sp_pos_base + jnp.broadcast_to(
+                jnp.arange(seqlen, dtype=jnp.int32), (mb, seqlen))
+        pad = (jax.lax.dynamic_index_in_dim(mask_m, idx, keepdims=False)
+               if mask_m is not None else None)
+        targets = jax.lax.dynamic_index_in_dim(targets_m, idx, keepdims=False)
+        cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta, dtype=cfg.dtype)
+        return my_ids, pad, cos, sin, targets
+
+    return mb, seqlen, mb_data
+
+
 def _pipeline_loss_local(
     params: Params,
     batch: Batch,
@@ -474,39 +692,35 @@ def _pipeline_loss_local(
     this dp-shard's [M*mb, L]. Returns local (loss_sum, token_count) pairs
     (pre-psum) — plus, with `collect_stats`, this stage's activation
     (absmax, mean-square sum, tick count) accumulators over its LIVE ticks.
-    The caller reduces and differentiates."""
+    The caller reduces and differentiates.
+
+    Understands interleaved manifests (pcfg.virtual_stages > 1, layer leaves
+    [1, v, k, ...]): the forward walks the v*S virtual-stage ring with the
+    interleaved unit ordering, which is what lets
+    `make_pipeline_eval_fn` evaluate a training run configured with
+    `schedule: interleaved_1f1b` (training grads for that schedule use
+    `_pipeline_interleaved_1f1b_local`, not AD of this loop)."""
     s_total = pcfg.num_stages
+    v = pcfg.virtual_stages
     m_total = pcfg.num_microbatches
+    n_units = m_total * v
     stage = jax.lax.axis_index(AXIS_PP)
     is_first = stage == 0
     is_last = stage == s_total - 1
 
-    local_layers = jax.tree.map(lambda x: x[0], params["layers"])  # [k, ...]
+    local_layers = jax.tree.map(lambda x: x[0], params["layers"])  # [(v,) k, ...]
+    if collect_stats and v > 1:
+        raise NotImplementedError(
+            "collect_stats on the forward-only loop is gpipe-only; "
+            "interleaved training stats come from "
+            "_pipeline_interleaved_1f1b_local")
 
-    ids = batch["input_ids"]
-    bsz, seqlen = ids.shape
-    if bsz % m_total:
-        raise ValueError(f"per-dp batch {bsz} not divisible by microbatches {m_total}")
-    mb = bsz // m_total
-
-    def mb_view(x):
-        return x.reshape((m_total, mb) + x.shape[1:])
-
-    num_ticks = m_total + s_total - 1
+    mb, seqlen, mb_data = _mb_streams(batch, cfg, pcfg)
+    num_ticks = n_units + s_total - 1
     hidden_shape = (mb, seqlen, cfg.hidden_size)
     x_init = jnp.zeros(hidden_shape, cfg.dtype)
     tp_size = compat.axis_size(AXIS_TP)
     sp_size = compat.axis_size(AXIS_SP)
-    # seqlen here is the LOCAL slab length; fallback positions must be global
-    sp_pos_base = jax.lax.axis_index(AXIS_SP) * seqlen if sp_size > 1 else 0
-
-    ids_m = mb_view(ids)
-    mask_m = mb_view(batch["attention_mask"]) if batch.get("attention_mask") is not None else None
-    pos_m = mb_view(batch["position_ids"]) if batch.get("position_ids") is not None else None
-    # Next-token targets, shifted ONCE for the whole chunk (batch-dim
-    # microbatch slicing commutes with the sequence-dim shift; under sp the
-    # shift is a collective, kept off the per-tick path)
-    targets_m = mb_view(_sp_shift_labels(batch["labels"], sp_size))
 
     def mb_loss(h, targets, take):
         """Per-microbatch loss from last-stage hiddens. Checkpointed in the
@@ -542,41 +756,33 @@ def _pipeline_loss_local(
 
     def tick(carry, t):
         x_prev, loss_sum, count, act_stats = carry
-        # Microbatch indices for this tick: stage 0 consumes microbatch t;
-        # this stage computes microbatch (t - stage).
-        in_idx = jnp.clip(t, 0, m_total - 1)
+        # Unit for this tick: stage 0 consumes unit t; this stage computes
+        # unit (t - stage). At v == 1 a unit IS a microbatch.
         my_idx = t - stage
+        u = jnp.clip(my_idx, 0, n_units - 1)
+        mb_idx, ch = _unit_mb_chunk(u, s_total, v)
+        mb_idx = jnp.clip(mb_idx, 0, m_total - 1)
 
-        my_ids = jax.lax.dynamic_index_in_dim(ids_m, in_idx, keepdims=False)
+        my_ids, pad_mask, cos, sin, targets = mb_data(mb_idx)
         emb = llama.embed(params, my_ids, cfg)
-        x_in = jnp.where(is_first, emb, x_prev)
-
-        # Per-microbatch rope/mask for THIS stage's microbatch.
-        mb_idx = jnp.clip(my_idx, 0, m_total - 1)
-        if pos_m is not None:
-            pos = jax.lax.dynamic_index_in_dim(pos_m, mb_idx, keepdims=False)
-        else:
-            pos = sp_pos_base + jnp.broadcast_to(
-                jnp.arange(seqlen, dtype=jnp.int32), (mb, seqlen))
-        if mask_m is not None:
-            pad_mask = jax.lax.dynamic_index_in_dim(mask_m, mb_idx, keepdims=False)
-        else:
-            pad_mask = None
-        cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta, dtype=cfg.dtype)
+        x_in = jnp.where(is_first & (ch == 0), emb, x_prev)
 
         tp_axis = AXIS_TP if tp_size > 1 else None
-        k_max = jax.tree.leaves(local_layers)[0].shape[0]
-        y = llama.run_layers(local_layers, x_in, pad_mask, cos, sin, cfg,
+        chunk_layers = (jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, ch, keepdims=False),
+            local_layers) if v > 1 else local_layers)
+        k_max = jax.tree.leaves(chunk_layers)[0].shape[0]
+        y = llama.run_layers(chunk_layers, x_in, pad_mask, cos, sin, cfg,
                              attn_fn=attn_fn, remat=pcfg.remat, tp_axis=tp_axis,
                              remat_policy=pcfg.remat_policy,
                              slot_valid=_slot_valid(pcfg, stage, tp_size,
-                                                    sp_size, k_max))
+                                                    sp_size, k_max)
+                             if v == 1 else None)
 
         # The last stage's finished microbatch contributes its loss in-tick
         # (nothing is collected into an M-sized buffer; the head itself is
         # cond-gated inside mb_loss so only the owning stage pays it).
-        targets = jax.lax.dynamic_index_in_dim(targets_m, mb_idx, keepdims=False)
-        take = is_last & (my_idx >= 0)
+        take = is_last & (ch == v - 1) & (my_idx >= 0)
         mb_sum, mb_count = mb_loss(y, targets, take)
         loss_sum = loss_sum + jnp.where(take, mb_sum, 0.0)
         count = count + jnp.where(take, mb_count, 0)
@@ -584,7 +790,7 @@ def _pipeline_loss_local(
         if collect_stats:
             # Stage-boundary activation stats over this stage's LIVE ticks
             # (warmup/drain ticks recompute a clipped microbatch — masked).
-            live = (my_idx >= 0) & (my_idx < m_total)
+            live = (my_idx >= 0) & (my_idx < n_units)
             act_stats = _act_stat_update(act_stats, y, live)
 
         # Hand off to the next stage over the ICI ring (NCCL-P2P analogue).
@@ -662,38 +868,7 @@ def _pipeline_1f1b_local(
     tp_axis = AXIS_TP if tp_size > 1 else None
     sp_size = compat.axis_size(AXIS_SP)
 
-    ids = batch["input_ids"]
-    bsz, seqlen = ids.shape
-    if bsz % m_total:
-        raise ValueError(f"per-dp batch {bsz} not divisible by microbatches {m_total}")
-    mb = bsz // m_total
-    # seqlen here is the LOCAL slab length; fallback positions must be global
-    sp_pos_base = jax.lax.axis_index(AXIS_SP) * seqlen if sp_size > 1 else 0
-
-    def mb_view(x):
-        return x.reshape((m_total, mb) + x.shape[1:])
-
-    ids_m = mb_view(ids)
-    mask_m = mb_view(batch["attention_mask"]) if batch.get("attention_mask") is not None else None
-    pos_m = mb_view(batch["position_ids"]) if batch.get("position_ids") is not None else None
-    # Pre-shift to next-token targets ONCE for the whole chunk (microbatch
-    # slicing is over the batch dim, so it commutes with the sequence-dim
-    # shift): under sp the shift is a collective, and hoisting it here keeps
-    # it off the schedule's per-tick critical path AND stage-uniform.
-    targets_m = mb_view(_sp_shift_labels(batch["labels"], sp_size))
-
-    def mb_data(idx):
-        my_ids = jax.lax.dynamic_index_in_dim(ids_m, idx, keepdims=False)
-        if pos_m is not None:
-            pos = jax.lax.dynamic_index_in_dim(pos_m, idx, keepdims=False)
-        else:
-            pos = sp_pos_base + jnp.broadcast_to(
-                jnp.arange(seqlen, dtype=jnp.int32), (mb, seqlen))
-        pad = (jax.lax.dynamic_index_in_dim(mask_m, idx, keepdims=False)
-               if mask_m is not None else None)
-        targets = jax.lax.dynamic_index_in_dim(targets_m, idx, keepdims=False)
-        cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta, dtype=cfg.dtype)
-        return my_ids, pad, cos, sin, targets
+    mb, seqlen, mb_data = _mb_streams(batch, cfg, pcfg)
 
     def stage_fwd(p, x_in, my_ids, pad, cos, sin, targets, with_loss,
                   loss_gate=None):
@@ -833,6 +1008,238 @@ def _pipeline_1f1b_local(
     return loss_acc / global_count, grads
 
 
+def _pipeline_interleaved_1f1b_local(
+    params: Params,
+    batch: Batch,
+    cfg: LlamaConfig,
+    pcfg: PipelineConfig,
+    attn_fn: Callable,
+    global_count: jnp.ndarray,
+    collect_stats: bool = False,
+) -> tuple:
+    """Interleaved one-forward-one-backward: virtual pipeline stages
+    (Megatron-style, OptPipe/PAPERS.md trade space) with the SAME
+    hand-written per-tick `jax.vjp` backward as the flat schedule.
+
+    Runs INSIDE shard_map; returns this shard's (normalized loss, grads) —
+    the caller psums. Each stage owns v = `virtual_stages` round-robin layer
+    chunks (manifest.py; layer leaves [1, v, k, ...] locally), so one
+    microbatch laps the pp ring v times and the pipeline FILL shrinks from
+    S full-stage forwards to vS chunk forwards — 1/v of a microbatch's
+    forward work per fill slot. Scheduling unit = (microbatch, chunk); unit
+    ordering and why the plain ring ppermute carries chunk transitions too:
+    see `_unit_mb_chunk`. Timeline (tick t, stage s, S stages, M
+    microbatches, N = Mv units, D = (v+1)S - 2):
+
+        forward  of unit t - s
+        backward of unit t - (D - s)
+
+    so the last stage backprops the last chunk of a microbatch the same tick
+    it finishes it (at v=1 this IS the flat schedule: D = 2S - 2). The run
+    is phased into three scans over the same tick clock:
+
+        [0, vS-1)          forward-only warmup  (no backward work exists
+                           anywhere: the first unit only clears the vS-1
+                           ring hops of the virtual pipeline at tick vS-1)
+        [vS-1, N+S-1)      steady 1F1B, both halves per tick
+        [N+S-1, N+D)       backward-only drain (all forwards are done)
+
+    Phasing is what buys the interleaved bubble: a warmup tick costs one
+    chunk FORWARD (not a full fwd+bwd tick with a masked backward half), a
+    drain tick one chunk backward, so warmup+drain pair into vS-1 full
+    chunk ticks and the flush totals Mv + S - 1 chunk-tick equivalents —
+    bubble (S-1)/(Mv + S - 1), vs 2(S-1)/(M + 2(S-1)) flat
+    (`bubble_fraction`; docs/SCHEDULES.md has the accounting).
+
+    Ring-buffer liveness for v chunks: unit f's input slot (f mod B) is
+    reused by unit f + B at tick f + B + s; unit f's backward reads it at
+    tick f + (v-1-2*ch)S + D - s <= f + (v-1)S + D - s, and
+    B = 2vS - 1 > (v-1)S + D - 2s for all s >= 0 — so B = min(2vS-1, Mv)
+    slots suffice, the v-chunk generalization of the flat min(2S-1, M).
+    Warmup/drain masking is zero cotangents through the linear vjp, exactly
+    as the flat schedule does; embed runs under `lax.cond` on
+    (stage 0, chunk 0), the loss head on (last stage, chunk v-1, live),
+    with every collective kept outside stage-divergent conds (the same
+    hard rule, see `_pipeline_1f1b_local`)."""
+    s_total = pcfg.num_stages
+    v = pcfg.virtual_stages
+    m_total = pcfg.num_microbatches
+    n_units = m_total * v
+    stage = jax.lax.axis_index(AXIS_PP)
+    is_first = stage == 0
+    is_last = stage == s_total - 1
+    tp_size = compat.axis_size(AXIS_TP)
+    tp_axis = AXIS_TP if tp_size > 1 else None
+
+    mb, seqlen, mb_data = _mb_streams(batch, cfg, pcfg)
+
+    def chunk_fwd(p, x_in, ch, my_ids, pad, cos, sin, targets, with_loss,
+                  loss_gate=None):
+        """One virtual chunk forward (+ cond-gated loss head). `ch` is the
+        traced virtual-chunk index; the chunk's layers are dynamically
+        sliced from the [v, k, ...] local leaves, so the param-side vjp
+        scatter-adds each chunk's gradient into its own slice (zeros
+        elsewhere — exact, not approximate)."""
+        x0 = jax.lax.cond(
+            is_first & (ch == 0),
+            lambda emb, x: llama.embed({"embed": emb}, my_ids, cfg),
+            lambda emb, x: x,
+            p["embed"], x_in)
+        if v == 1:  # degenerate: flat [1, k, ...] leaves, the one chunk
+            chunk_layers = jax.tree.map(lambda a: a[0], p["layers"])
+        else:
+            chunk_layers = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a[0], ch, keepdims=False),
+                p["layers"])
+        y = llama.run_layers(chunk_layers, x0, pad, cos, sin, cfg,
+                             attn_fn=attn_fn, remat=pcfg.remat,
+                             tp_axis=tp_axis, remat_policy=pcfg.remat_policy)
+        if not with_loss:
+            return y
+
+        owns_loss = is_last & (ch == v - 1)
+        gate = owns_loss if loss_gate is None else owns_loss & loss_gate
+        if tp_size > 1:
+            # tp collectives stay stage-uniform; the heavy matmul + CE stats
+            # are cond-gated inside (_vocab_parallel_token_loss, `last_stage`
+            # mode) — identical structure to the flat schedule's head.
+            h = llama.final_norm({"norm": p["norm"]}, y, cfg)
+            mb_sum = _vocab_parallel_token_loss(
+                {"lm_head": p["lm_head"]}, h, targets, cfg,
+                preshifted=True, last_stage=gate)[0]
+        else:
+            def head_branch(norm_w, head_w, y_):
+                h = llama.final_norm({"norm": norm_w}, y_, cfg)
+                if pcfg.loss_chunks > 1:
+                    from llama_pipeline_parallel_tpu.ops.cross_entropy import fused_ce_sum_count
+
+                    return fused_ce_sum_count(h, head_w.astype(cfg.dtype),
+                                              targets, pcfg.loss_chunks)[0]
+                logits = llama.lm_head({"lm_head": head_w}, h, cfg)
+                return llama.token_loss_sum_and_count_preshifted(logits, targets)[0]
+
+            mb_sum = jax.lax.cond(
+                gate, head_branch, lambda norm_w, head_w, y_: jnp.float32(0.0),
+                p["norm"], p["lm_head"], y)
+        return y, mb_sum
+
+    warm = v * s_total - 1
+    d_off = (v + 1) * s_total - 2
+    num_ticks = n_units + d_off
+    fwd_end = n_units + s_total - 1  # first tick with no forward work anywhere
+    n_steady = max(fwd_end - warm, 0)
+    n_drain = num_ticks - warm - n_steady
+    b_slots = min(2 * v * s_total - 1, n_units)
+    hidden_shape = (mb, seqlen, cfg.hidden_size)
+    fwd_perm = [(i, (i + 1) % s_total) for i in range(s_total)]
+    bwd_perm = [(i, (i - 1) % s_total) for i in range(s_total)]
+
+    def fwd_half(t, x_recv, xbuf):
+        f = t - stage
+        f_valid = (f >= 0) & (f < n_units)
+        f_c = jnp.clip(f, 0, n_units - 1)
+        mb_f, ch_f = _unit_mb_chunk(f_c, s_total, v)
+        ids_f, pad_f, cos_f, sin_f, _ = mb_data(jnp.clip(mb_f, 0, m_total - 1))
+        y_f = chunk_fwd(params, x_recv, ch_f, ids_f, pad_f, cos_f, sin_f,
+                        None, with_loss=False)
+        # Buffer the raw received chunk input for the later backward
+        # recompute; predicated so warmup/drain clipping never clobbers a
+        # live slot (same contract as the flat schedule's buffer).
+        slot_f = f_c % b_slots
+        old = jax.lax.dynamic_index_in_dim(xbuf, slot_f, keepdims=False)
+        xbuf = jax.lax.dynamic_update_index_in_dim(
+            xbuf, jnp.where(f_valid, x_recv, old), slot_f, 0)
+        return y_f, xbuf
+
+    def bwd_half(t, dy_recv, xbuf, gacc, loss_acc, act_stats):
+        g = t - (d_off - stage)
+        b_valid = (g >= 0) & (g < n_units)
+        g_c = jnp.clip(g, 0, n_units - 1)
+        mb_b, ch_b = _bwd_unit_mb_chunk(g_c, s_total, v)
+        mb_b = jnp.clip(mb_b, 0, m_total - 1)
+        # the FORWARD unit index of this backward unit, for the buffer slot
+        f_idx = ((g_c // (v * s_total)) * (v * s_total)
+                 + ch_b * s_total + g_c % s_total)
+        ids_b, pad_b, cos_b, sin_b, targets_b = mb_data(mb_b)
+        x_in_b = jax.lax.dynamic_index_in_dim(xbuf, f_idx % b_slots,
+                                              keepdims=False)
+
+        def h(p, x_in):
+            return chunk_fwd(p, x_in, ch_b, ids_b, pad_b, cos_b, sin_b,
+                             targets_b, with_loss=True, loss_gate=b_valid)
+
+        (y_b, mb_sum), pullback = jax.vjp(h, params, x_in_b)
+        if collect_stats:
+            # chunk-boundary activation stats from the backward recompute,
+            # indexed [v] by this unit's chunk (-> [S, v] after stitching)
+            act_stats = _act_stat_update_chunk(act_stats, y_b, b_valid, ch_b, v)
+        # Only the (last stage, chunk v-1) unit ends the virtual pipeline —
+        # every OTHER last-stage chunk's output went to stage 0, so it DOES
+        # consume the ring cotangent. vjp is linear in the cotangent, so
+        # masked ticks contribute exactly zero.
+        owns_loss = is_last & (ch_b == v - 1)
+        dy_ct = jnp.where(b_valid & ~owns_loss, 1.0, 0.0).astype(cfg.dtype) * dy_recv
+        loss_ct = jnp.where(b_valid, 1.0, 0.0) / global_count
+        dparams, dx = pullback((dy_ct, loss_ct))
+        gacc = jax.tree.map(jnp.add, gacc, dparams)
+        loss_acc = loss_acc + jnp.where(b_valid, mb_sum, 0.0)
+        return dx, gacc, loss_acc, act_stats
+
+    # -- the three phases over one tick clock -------------------------------
+    # (ppermutes sit outside every cond and run phase-uniformly: the phase
+    # boundary is a function of the tick index alone, identical on every
+    # stage, so no device ever skips a collective its peers execute)
+
+    def warm_tick(carry, t):
+        x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats = carry
+        y_f, xbuf = fwd_half(t, x_recv, xbuf)
+        x_next = (jax.lax.ppermute(y_f, AXIS_PP, fwd_perm)
+                  if s_total > 1 else y_f)
+        return (x_next, dy_recv, xbuf, gacc, loss_acc, act_stats), None
+
+    def steady_tick(carry, t):
+        x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats = carry
+        y_f, xbuf = fwd_half(t, x_recv, xbuf)
+        dx, gacc, loss_acc, act_stats = bwd_half(t, dy_recv, xbuf, gacc,
+                                                 loss_acc, act_stats)
+        if s_total > 1:
+            x_next = jax.lax.ppermute(y_f, AXIS_PP, fwd_perm)
+            dy_next = jax.lax.ppermute(dx, AXIS_PP, bwd_perm)
+        else:
+            x_next, dy_next = y_f, dx
+        return (x_next, dy_next, xbuf, gacc, loss_acc, act_stats), None
+
+    def drain_tick(carry, t):
+        x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats = carry
+        dx, gacc, loss_acc, act_stats = bwd_half(t, dy_recv, xbuf, gacc,
+                                                 loss_acc, act_stats)
+        dy_next = (jax.lax.ppermute(dx, AXIS_PP, bwd_perm)
+                   if s_total > 1 else dx)
+        return (x_recv, dy_next, xbuf, gacc, loss_acc, act_stats), None
+
+    carry = (
+        jnp.zeros(hidden_shape, cfg.dtype),
+        jnp.zeros(hidden_shape, cfg.dtype),
+        jnp.zeros((b_slots,) + hidden_shape, cfg.dtype),
+        jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), params),
+        jnp.float32(0.0),
+        _act_stats_zero_chunks(v),
+    )
+    if warm:
+        carry, _ = jax.lax.scan(warm_tick, carry, jnp.arange(warm))
+    if n_steady:
+        carry, _ = jax.lax.scan(steady_tick, carry,
+                                jnp.arange(warm, warm + n_steady))
+    if n_drain:
+        carry, _ = jax.lax.scan(drain_tick, carry,
+                                jnp.arange(num_ticks - n_drain, num_ticks))
+    _, _, _, grads, loss_acc, act_stats = carry
+    # loss_acc is nonzero on the last stage only (cond zero branch elsewhere)
+    if collect_stats:
+        return loss_acc / global_count, grads, act_stats
+    return loss_acc / global_count, grads
+
+
 def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn,
                          collect_stats=False):
     """shard_map body: global-mean loss + fully reduced grads (+ per-stage
@@ -856,12 +1263,14 @@ def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn,
     chunk_pcfg = dataclasses.replace(
         pcfg, num_microbatches=pcfg.num_microbatches // chunks, accum_chunks=1)
 
-    if pcfg.schedule == "1f1b":
+    if pcfg.schedule in ("1f1b", "interleaved_1f1b"):
+        sched_fn = (_pipeline_1f1b_local if pcfg.schedule == "1f1b"
+                    else _pipeline_interleaved_1f1b_local)
+
         def chunk_loss_and_grad(p, chunk_batch):
-            out = _pipeline_1f1b_local(p, chunk_batch, cfg, chunk_pcfg, attn_fn,
-                                       global_count,
-                                       collect_stats=collect_stats)
-            return out if collect_stats else (*out, _ACT_STATS_ZERO())
+            out = sched_fn(p, chunk_batch, cfg, chunk_pcfg, attn_fn,
+                           global_count, collect_stats=collect_stats)
+            return out if collect_stats else (*out, _sched_act_stats_zero(pcfg))
     else:
         def chunk_loss(p, chunk_batch):
             out = _pipeline_loss_local(p, chunk_batch, cfg, chunk_pcfg, attn_fn,
@@ -896,7 +1305,8 @@ def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn,
 
         zero_grads = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), params)
         (local_loss, grads, act_stats), _ = jax.lax.scan(
-            accum, (jnp.float32(0.0), zero_grads, _ACT_STATS_ZERO()), chunked)
+            accum, (jnp.float32(0.0), zero_grads, _sched_act_stats_zero(pcfg)),
+            chunked)
     loss = jax.lax.psum(local_loss, (AXIS_PP, AXIS_DP, AXIS_SP))
 
     # Stage-sharded leaves: reduce across dp replicas and sp shards (each sp
@@ -912,15 +1322,50 @@ def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn,
     # Per-stage activation stats stay STAGE-LOCAL over pp (out_spec P(pp)
     # stitches the [1]-shaped shard values into the global [S] vector) but
     # must be replicated over dp/sp/tp for the out_spec to be truthful:
-    # absmax -> pmax, rms -> tick-weighted mean of mean-squares.
+    # absmax -> pmax, rms -> tick-weighted mean of mean-squares. Under the
+    # interleaved schedule the accumulators are [v] per shard and the
+    # reductions are elementwise; the stats then index [S, v] (the
+    # *_per_chunk keys) with the per-stage keys reduced over chunks.
     absmax, msq_sum, n = act_stats
     absmax = jax.lax.pmax(absmax, (AXIS_DP, AXIS_SP, AXIS_TP))
-    msq = (jax.lax.psum(msq_sum, (AXIS_DP, AXIS_SP))
-           / jnp.maximum(jax.lax.psum(n, (AXIS_DP, AXIS_SP)), 1.0))
-    msq = jax.lax.pmax(msq, AXIS_TP)  # tp replicas agree; pmax re-asserts it
-    stats = {"act_absmax_per_stage": absmax.reshape(1),
-             "act_rms_per_stage": jnp.sqrt(msq).reshape(1)}
+    msq_sum = jax.lax.psum(msq_sum, (AXIS_DP, AXIS_SP))
+    n = jax.lax.psum(n, (AXIS_DP, AXIS_SP))
+    msq = jax.lax.pmax(msq_sum / jnp.maximum(n, 1.0),
+                       AXIS_TP)  # tp replicas agree; pmax re-asserts it
+    if pcfg.schedule == "interleaved_1f1b":
+        v = pcfg.virtual_stages
+        stage_msq = jax.lax.pmax(
+            jnp.sum(msq_sum) / jnp.maximum(jnp.sum(n), 1.0), AXIS_TP)
+        stats = {"act_absmax_per_chunk": absmax.reshape(1, v),
+                 "act_rms_per_chunk": jnp.sqrt(msq).reshape(1, v),
+                 "act_absmax_per_stage": jnp.max(absmax).reshape(1),
+                 "act_rms_per_stage": jnp.sqrt(stage_msq).reshape(1)}
+    else:
+        stats = {"act_absmax_per_stage": absmax.reshape(1),
+                 "act_rms_per_stage": jnp.sqrt(msq).reshape(1)}
     return loss, grads, stats
+
+
+def _check_stacked_layout(params_like: Params, pcfg: PipelineConfig) -> None:
+    """The stacked param layout must match the schedule: interleaved wants
+    the virtual-chunk axis ([S, v, k, ...] — stack_stages with a
+    virtual_stages manifest), flat/gpipe the plain [S, k, ...]. A mismatch
+    here means the manifest and the PipelineConfig came from different
+    places; failing at build time beats a shape error deep inside shard_map."""
+    shape = tuple(params_like["layers"]["attn"]["wq"].shape)
+    if pcfg.schedule == "interleaved_1f1b" and pcfg.virtual_stages > 1:
+        if len(shape) != 5 or shape[1] != pcfg.virtual_stages:
+            raise ValueError(
+                f"schedule=interleaved_1f1b (virtual_stages="
+                f"{pcfg.virtual_stages}) needs params stacked "
+                f"[S, v, k, ...] — build them with stack_stages on a "
+                f"StageManifest(virtual_stages={pcfg.virtual_stages}); got "
+                f"a layer leaf of shape {shape}")
+    elif len(shape) != 4:
+        raise ValueError(
+            f"schedule={pcfg.schedule!r} expects flat-stacked params "
+            f"[S, k, ...]; got a layer leaf of shape {shape} (stacked with "
+            f"a virtual_stages manifest? set schedule: interleaved_1f1b)")
 
 
 def make_pipeline_eval_fn(
@@ -937,6 +1382,7 @@ def make_pipeline_eval_fn(
     (conf yaml:71-72,113-114 reference absent classes; SURVEY.md §2.4) — its
     trainer has no eval loop at all.
     """
+    _check_stacked_layout(params_like, pcfg)
     param_specs = stage_param_specs(params_like, tp=mesh.shape[AXIS_TP] > 1)
     b_specs = batch_specs(mesh)
     if mesh.shape[AXIS_SP] > 1:
@@ -978,6 +1424,7 @@ def make_pipeline_loss_and_grad(
         raise ValueError(
             f"PipelineConfig.num_stages={pcfg.num_stages} does not match the "
             f"mesh pp axis size {mesh.shape[AXIS_PP]}")
+    _check_stacked_layout(params_like, pcfg)
     sp = mesh.shape[AXIS_SP]
     tp = mesh.shape[AXIS_TP]
     if pcfg.layer_counts is not None:
@@ -1026,8 +1473,13 @@ def make_pipeline_loss_and_grad(
 
     out_specs: tuple = (P(), param_specs)
     if collect_stats:
-        out_specs += ({"act_absmax_per_stage": P(AXIS_PP),
-                       "act_rms_per_stage": P(AXIS_PP)},)
+        stats_specs = {"act_absmax_per_stage": P(AXIS_PP),
+                       "act_rms_per_stage": P(AXIS_PP)}
+        if pcfg.schedule == "interleaved_1f1b":
+            # [1, v] local -> [S, v] global; the chunk axis is replicated
+            stats_specs.update({"act_absmax_per_chunk": P(AXIS_PP),
+                                "act_rms_per_chunk": P(AXIS_PP)})
+        out_specs += (stats_specs,)
     fn = shard_map(
         partial(_loss_and_grad_local, cfg=cfg, pcfg=pcfg, attn_fn=attn_fn,
                 collect_stats=collect_stats),

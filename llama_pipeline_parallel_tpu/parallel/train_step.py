@@ -238,7 +238,8 @@ def make_train_step(
             "step": state.step + 1,
         }
         if collect_stats:
-            stats = numerics.step_stats(state.params, grads, updates)
+            stats = numerics.step_stats(state.params, grads, updates,
+                                        virtual_stages=pcfg.virtual_stages)
             stats.update(act_stats)
             # replicate the stat vectors (a few hundred floats): the host
             # monitor reads them with np.asarray, which on a pod requires

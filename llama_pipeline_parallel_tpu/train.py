@@ -97,12 +97,35 @@ def _packing_factor(cfg: dict) -> int:
     return int(cfg.get("packing_factor", 1) or 1)
 
 
+def _virtual_stages(cfg: dict) -> int:
+    """The `virtual_stages` knob (interleaved 1F1B, docs/SCHEDULES.md),
+    parsed in one place so trainer + preflight + manifest agree on it."""
+    v = int(cfg.get("virtual_stages", 1) or 1)
+    if v > 1 and cfg.get("pipeline_schedule", "1f1b") != "interleaved_1f1b":
+        raise ValueError(
+            f"virtual_stages={v} requires pipeline_schedule: "
+            f"interleaved_1f1b (got "
+            f"{cfg.get('pipeline_schedule', '1f1b')!r})")
+    return v
+
+
 def build_manifest(cfg: dict, model_cfg: LlamaConfig, pp: int) -> StageManifest:
     """Stage partition policy, shared by the trainer and tools/preflight.py
     (the preflight must compile the SAME program the trainer runs): explicit
     per-stage layer_counts > cost-balanced (`stage_balance: cost`, the
     SURVEY §7.3-item-2 MFU lever) > even split. Indivisible layer counts
-    fall back to cost-balanced automatically."""
+    fall back to cost-balanced automatically. `virtual_stages` > 1
+    (interleaved 1F1B) switches to the round-robin chunked layout — it
+    rejects uneven partitions (manifest.py), so layer_counts/stage_balance
+    cannot be combined with it."""
+    v = _virtual_stages(cfg)
+    if v > 1:
+        if cfg.get("layer_counts") or cfg.get("stage_balance", "even") == "cost":
+            raise ValueError(
+                "virtual_stages > 1 (interleaved 1F1B) uses the round-robin "
+                "even chunk partition; layer_counts/stage_balance: cost "
+                "cannot apply — drop them or fall back to a flat schedule")
+        return StageManifest.for_config(model_cfg, pp, virtual_stages=v)
     if cfg.get("layer_counts"):
         return StageManifest(num_layers=model_cfg.num_hidden_layers,
                              num_stages=pp,
@@ -126,6 +149,7 @@ def build_pipeline_config(cfg: dict, mesh_cfg: Any, manifest: StageManifest
         remat=cfg.get("activation_checkpointing", True),
         remat_policy=cfg.get("remat_policy", "nothing_saveable"),
         schedule=cfg.get("pipeline_schedule", "1f1b"),
+        virtual_stages=manifest.virtual_stages,
         accum_chunks=cfg.get("gradient_accumulation_chunks", 1),
         sequence_parallel=cfg.get("sequence_parallel", "ring"),
         loss_chunks=cfg.get("loss_vocab_chunks", 1),
@@ -1201,7 +1225,8 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
 
     def _grad_with_stats(p, batch):
         loss, grads, act_stats = loss_and_grad(p, batch)
-        stats = numerics.step_stats(p, grads)
+        stats = numerics.step_stats(p, grads,
+                                    virtual_stages=pcfg.virtual_stages)
         stats.update(act_stats)
         return loss, grads, _replicate_stats(stats)
 
@@ -1234,7 +1259,8 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
     device_params_box = [to_replicated(host.device_params(model_cfg.dtype))]
     # chaos-only second dispatch: the stats must see the POISONED grads
     stats_fn = (jax.jit(
-        lambda p, g: _replicate_stats(numerics.step_stats(p, g)))
+        lambda p, g: _replicate_stats(numerics.step_stats(
+            p, g, virtual_stages=pcfg.virtual_stages)))
         if ncfg.enabled and poison_on else None)
     poison_fn = jax.jit(numerics.poison_grads)
 

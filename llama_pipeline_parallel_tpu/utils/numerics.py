@@ -56,10 +56,14 @@ from llama_pipeline_parallel_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 # stats fields whose per-step jsonl record keeps the full per-stage vector;
-# everything else in the device stats tree is snapshot-only detail
+# everything else in the device stats tree is snapshot-only detail. The
+# *_per_chunk fields ([num_stages, virtual_stages] nested lists) exist only
+# under `schedule: interleaved_1f1b`, where each stage's activations are
+# resolved per virtual chunk (parallel/pipeline.py).
 PER_STAGE_FIELDS = ("grad_norm_per_stage", "param_norm_per_stage",
                     "update_norm_per_stage", "act_rms_per_stage",
-                    "act_absmax_per_stage")
+                    "act_absmax_per_stage", "act_rms_per_chunk",
+                    "act_absmax_per_chunk")
 
 
 class NonfiniteHaltError(RuntimeError):
@@ -158,13 +162,29 @@ def _layer_absmax(layers: Any):
     return out
 
 
-def step_stats(params: Any, grads: Any, updates: Any | None = None) -> dict:
+def _flatten_chunk_axis(layers: Any, virtual_stages: int) -> Any:
+    """Interleaved stacked leaves [S, v, k, ...] -> [S, v*k, ...]: the layer
+    SLOT axis becomes chunk-major (slot j is chunk j//k, layer j%k), so
+    every per-stage/per-slot reduction below works on either layout."""
+    import jax
+
+    if virtual_stages == 1:
+        return layers
+    return jax.tree.map(
+        lambda x: x.reshape((x.shape[0], x.shape[1] * x.shape[2]) + x.shape[3:]),
+        layers)
+
+
+def step_stats(params: Any, grads: Any, updates: Any | None = None,
+               virtual_stages: int = 1) -> dict:
     """Per-stage / per-layer-group statistics of one step, computed in-graph.
 
     `params`/`grads` (and optionally `updates`) are the stage-stacked trees
-    (layer leaves [S, k, ...]); all reductions preserve the leading stage
-    axis, so every output is an [S] vector, an [S, k] grid, or a scalar —
-    a few hundred floats total, fetched asynchronously by the monitor.
+    (layer leaves [S, k, ...], or [S, v, k, ...] under `virtual_stages` > 1
+    — flattened to chunk-major [S, v*k, ...] slots first); all reductions
+    preserve the leading stage axis, so every output is an [S] vector, an
+    [S, slots] grid, or a scalar — a few hundred floats total, fetched
+    asynchronously by the monitor.
 
     Non-stacked leaves (embed/norm/lm_head) have no stage axis; they get
     scalar absmax entries under `replicated_groups` (the pipeline places
@@ -173,11 +193,13 @@ def step_stats(params: Any, grads: Any, updates: Any | None = None) -> dict:
     """
     import jax.numpy as jnp
 
+    grad_layers = _flatten_chunk_axis(grads["layers"], virtual_stages)
+    param_layers = _flatten_chunk_axis(params["layers"], virtual_stages)
     stats = {
-        "grad_norm_per_stage": jnp.sqrt(_stage_sumsq(grads["layers"])),
-        "param_norm_per_stage": jnp.sqrt(_stage_sumsq(params["layers"])),
-        "grad_absmax_per_group": _group_absmax(grads["layers"]),
-        "grad_absmax_per_layer": _layer_absmax(grads["layers"]),
+        "grad_norm_per_stage": jnp.sqrt(_stage_sumsq(grad_layers)),
+        "param_norm_per_stage": jnp.sqrt(_stage_sumsq(param_layers)),
+        "grad_absmax_per_group": _group_absmax(grad_layers),
+        "grad_absmax_per_layer": _layer_absmax(grad_layers),
         "replicated_groups": {
             key: jnp.max(jnp.abs(jnp.asarray(
                 grads[key]["embedding"] if key == "embed" else grads[key]
@@ -187,7 +209,8 @@ def step_stats(params: Any, grads: Any, updates: Any | None = None) -> dict:
         "nonfinite": ~_tree_finite(grads),
     }
     if updates is not None:
-        stats["update_norm_per_stage"] = jnp.sqrt(_stage_sumsq(updates["layers"]))
+        stats["update_norm_per_stage"] = jnp.sqrt(_stage_sumsq(
+            _flatten_chunk_axis(updates["layers"], virtual_stages)))
     return stats
 
 

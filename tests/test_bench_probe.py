@@ -1,0 +1,40 @@
+"""bench.py's fail-fast device probe (BENCH_r05: an unreachable TPU used to
+burn the full 900 s watchdog before the error JSON appeared; the probe
+bounds that to BENCH_PROBE_TIMEOUT_S)."""
+
+import os
+import sys
+import time
+
+import jax
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from bench import _probe_devices  # noqa: E402
+
+
+def test_probe_passes_on_live_backend(devices):
+    assert _probe_devices(timeout_s=60.0) is None
+
+
+def test_probe_reports_wedged_backend(monkeypatch):
+    """A backend that never answers (the blocking-C-call wedge) turns into
+    an error string within the timeout instead of hanging forever."""
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: time.sleep(3600))
+    err = _probe_devices(timeout_s=0.2)
+    assert err is not None and "did not respond" in err
+
+
+def test_probe_reports_broken_backend(monkeypatch):
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("no TPU visible")))
+    err = _probe_devices(timeout_s=5.0)
+    assert err is not None and "no TPU visible" in err
+
+
+def test_probe_reports_empty_device_list(monkeypatch):
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: [])
+    err = _probe_devices(timeout_s=5.0)
+    assert err is not None and "no devices" in err

@@ -54,3 +54,39 @@ def test_stage_index_inside_shard_map(devices):
     np.testing.assert_array_equal(np.asarray(stages), [0, 1, 2, 3])
     np.testing.assert_array_equal(np.asarray(dps), [0, 1])
     np.testing.assert_array_equal(np.asarray(last), [False, False, False, True])
+
+
+def test_underuse_warning_once_per_layout(devices):
+    """The 'mesh uses N of M devices' warning fires once per distinct
+    layout, not on every mesh build (it used to repeat dozens of times in a
+    dryrun sweep — MULTICHIP_r05)."""
+    import logging
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    layout_a = MeshConfig(pp=3)
+    layout_b = MeshConfig(dp=3)
+    # hermetic: an earlier build of these layouts (or an in-process re-run
+    # of this test) must not pre-latch the warn-once set
+    mesh_lib._UNDERUSE_WARNED.discard((3, 8, 3, 1, 1, 1))
+    mesh_lib._UNDERUSE_WARNED.discard((3, 8, 1, 3, 1, 1))
+
+    handler = Capture(level=logging.WARNING)
+    logger = logging.getLogger("llama_pipeline_parallel_tpu.parallel.mesh")
+    logger.addHandler(handler)
+    try:
+        def warnings_for(cfg):
+            records.clear()
+            make_mesh(cfg)
+            return [m for m in records if "available devices" in m]
+
+        assert len(warnings_for(layout_a)) == 1
+        assert len(warnings_for(layout_a)) == 0   # repeat build: silent
+        assert len(warnings_for(layout_b)) == 1   # a NEW layout still warns
+        assert len(warnings_for(layout_b)) == 0
+    finally:
+        logger.removeHandler(handler)
