@@ -10,8 +10,13 @@ parsing from `checkpoint-N` dirnames (trainer_base_ds_mp.py:452-455).
 Design differences from the reference:
 - Canonical layout: params are stored with layer leaves `[num_layers, ...]`,
   never `[num_stages, layers_per_stage, ...]`; the stage manifest is metadata,
-  not filename arithmetic. Any PP topology restores any checkpoint
-  (the reference forbids exactly this, SURVEY.md §7.3 item 5).
+  not filename arithmetic. Any topology restores any checkpoint — pp resize,
+  dp shrink/grow, flat<->interleaved — via resharded Orbax reads against the
+  CURRENT run's templates (the reference forbids exactly this, SURVEY.md
+  §7.3 item 5; docs/RESILIENCE.md "Elastic resume"). meta.json additionally
+  records the writer's `topology` and sampler `data_state` (via save's
+  `extra_meta=`) so a resume can explain the resize and reposition the data
+  stream in O(1).
 - Params and optimizer state are separate Orbax items, so a module-only warm
   start from a FULL training checkpoint needs no monkey-patch — it simply
   doesn't open the optimizer item.
@@ -347,7 +352,8 @@ class CheckpointManager:
     def save(self, step: int, params_stacked: dict, manifest: StageManifest,
              cfg: LlamaConfig, opt_state: Any | None = None,
              blocking: bool = True, on_complete: Any = None,
-             keep_last: int | None = None) -> str:
+             keep_last: int | None = None,
+             extra_meta: dict | None = None) -> str:
         """Save train state (canonical layout) + metadata, update `latest`.
 
         `opt_state=None` produces a module-only checkpoint (the converter's
@@ -373,6 +379,12 @@ class CheckpointManager:
 
         `on_complete(path)` runs after the commit (in-thread when async) —
         the off-node sync hook's slot, so it never sees a half-written dir.
+
+        `extra_meta`: extra JSON-serializable keys merged into meta.json —
+        the trainer records the run's `topology` (source mesh/schedule) and
+        `data_state` (sampler position) here so an elastic resume can
+        reshard and reposition without replaying anything
+        (docs/RESILIENCE.md "Elastic resume").
         """
         self.finalize()
         path = self.step_dir(step)
@@ -389,7 +401,8 @@ class CheckpointManager:
 
             def commit():
                 self._commit(path, step, manifest, cfg,
-                             has_optimizer_state=opt_state is not None)
+                             has_optimizer_state=opt_state is not None,
+                             **(extra_meta or {}))
                 if on_complete is not None:
                     on_complete(path)
                 if keep_last:  # None/0 both mean "no retention limit"
@@ -414,7 +427,8 @@ class CheckpointManager:
         return path
 
     def save_offload(self, step: int, host, manifest: StageManifest,
-                     cfg: LlamaConfig, keep_last: int | None = None) -> str:
+                     cfg: LlamaConfig, keep_last: int | None = None,
+                     extra_meta: dict | None = None) -> str:
         """Streamed save for the host-offloaded optimizer: params, then m,
         then v, each assembled-and-written before the next is assembled —
         extra device HBM is bounded at ONE fp32 tree instead of three (at
@@ -435,7 +449,8 @@ class CheckpointManager:
                 self._ckptr.wait_until_finished()
             self._commit(path, step, manifest, cfg, has_optimizer_state=True,
                          opt_layout="offload_parts",
-                         opt_step_count=int(host.step_count))
+                         opt_step_count=int(host.step_count),
+                         **(extra_meta or {}))
             if keep_last:
                 self.prune(keep_last)
         return path

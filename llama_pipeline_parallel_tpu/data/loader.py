@@ -18,11 +18,15 @@ fetching real data, reference README.md:64-129).
 from __future__ import annotations
 
 import dataclasses
+import json
 from typing import Any, Callable, Iterator, Sequence
 
 import numpy as np
 
 from llama_pipeline_parallel_tpu.utils import faults, retry
+from llama_pipeline_parallel_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
 
 
 class CorruptRecordError(OSError):
@@ -96,6 +100,15 @@ class DataLoader:
     # multi-host: which dp replicas THIS process materializes (from
     # parallel.distributed.host_dp_shard); None = all of them
     dp_range: tuple[int, int] | None = None
+    # when a record stays unreadable/corrupt past the whole retry budget,
+    # quarantine it (skip + warn + counter, deterministic substitute record)
+    # instead of killing the run; default off — losing data silently is the
+    # wrong default, a config must opt in (docs/RESILIENCE.md)
+    quarantine_bad_records: bool = False
+    # append one {"epoch", "batch", "indices"} jsonl row per emitted batch —
+    # the per-sample-id ledger the elastic-resume chaos tests audit for
+    # zero dropped / zero duplicated samples across a topology resize
+    sample_ledger: str | None = None
 
     def __post_init__(self) -> None:
         first, count = self.dp_range if self.dp_range is not None else (0, self.dp_size)
@@ -111,6 +124,23 @@ class DataLoader:
                            shuffle=self.shuffle, seed=self.seed)
             for d in self._local_dp
         ]
+        self.records_read = 0       # successful dataset fetches (O(1)-resume probe)
+        self.quarantine_count = 0   # records skipped as persistently bad
+        self._quarantined: set[int] = set()
+        self._ledger_f = (open(self.sample_ledger, "a", buffering=1)
+                          if self.sample_ledger else None)
+
+    def close_ledger(self) -> None:
+        """Release the sample-ledger fd (the trainer calls this when the
+        step loop ends; repeated in-process runs must not leak one fd per
+        run). Safe no-op without a ledger; a prefetch producer caught
+        mid-write sees the None'd handle or a benign ValueError."""
+        f, self._ledger_f = self._ledger_f, None
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
 
     def set_epoch(self, epoch: int) -> None:
         for s in self._samplers:
@@ -120,7 +150,15 @@ class DataLoader:
         """Batches per epoch."""
         return self._samplers[0].num_samples_per_replica // self.per_replica_batch
 
-    def _read_record(self, index: int) -> Any:
+    @property
+    def global_batch_examples(self) -> int:
+        """Examples the WHOLE run consumes per step (all dp replicas, not
+        just this host's) — the unit of the deterministic data contract:
+        step b consumes exactly global-order positions [b*G, (b+1)*G) of the
+        epoch permutation, for any dp width (docs/RESILIENCE.md)."""
+        return self.dp_size * self.per_replica_batch
+
+    def _fetch(self, index: int) -> Any:
         """One dataset read under the shared transient-retry policy
         (docs/RESILIENCE.md): a storage blip or fault-injected failure on the
         prefetch producer re-fetches with backoff instead of propagating
@@ -135,18 +173,78 @@ class DataLoader:
                                          f"corrupt/empty record")
             return row
 
-        return retry.retry_call(read, policy=self._retry_policy,
-                                describe=f"dataset[{index}]")
+        row = retry.retry_call(read, policy=self._retry_policy,
+                               describe=f"dataset[{index}]")
+        self.records_read += 1
+        return row
 
-    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+    def _quarantine(self, index: int, err: BaseException | None) -> None:
+        self._quarantined.add(int(index))
+        self.quarantine_count += 1
+        logger.warning(
+            "quarantined persistently bad record %d (%d quarantined so far; "
+            "a deterministic substitute record trains in its place): %r",
+            index, self.quarantine_count, err)
+
+    def _read_record(self, index: int) -> Any:
+        """_fetch, plus the opt-in quarantine path: a record that stays
+        unreadable past the retry budget is marked bad and replaced by the
+        next healthy index (deterministic walk, so every replica/restart
+        substitutes identically) instead of killing training. Quarantined
+        indices are never re-fetched — later epochs substitute directly."""
+        index = int(index)
+        last: BaseException | None = None
+        if index not in self._quarantined:
+            try:
+                return self._fetch(index)
+            except OSError as e:
+                if not self.quarantine_bad_records:
+                    raise
+                self._quarantine(index, e)
+                last = e
+        n = len(self.dataset)
+        for offset in range(1, n):
+            idx = (index + offset) % n
+            if idx in self._quarantined:
+                continue
+            try:
+                return self._fetch(idx)
+            except OSError as e:
+                self._quarantine(idx, e)
+                last = e
+        raise CorruptRecordError(
+            f"every record is quarantined ({n} total); the data source "
+            f"is gone, not degraded") from last
+
+    def iter_batches(self, start_batch: int = 0
+                     ) -> Iterator[dict[str, np.ndarray]]:
+        """One epoch of batches, starting at `start_batch` — the skipped
+        prefix costs ZERO record reads (index arithmetic only), which is
+        what makes checkpoint resume O(1) instead of an O(resume_step)
+        replay of the loader."""
+        if not 0 <= start_batch <= len(self):
+            raise ValueError(f"start_batch {start_batch} outside "
+                             f"[0, {len(self)}]")
         per_replica = [s.indices() for s in self._samplers]
-        for b in range(len(self)):
-            rows = []
+        epoch = self._samplers[0]._epoch
+        for b in range(start_batch, len(self)):
+            rows, ids = [], []
             for local_idx, _ in enumerate(self._local_dp):
                 sl = per_replica[local_idx][
                     b * self.per_replica_batch:(b + 1) * self.per_replica_batch]
+                ids.extend(int(i) for i in sl)
                 rows.extend(self._read_record(int(i)) for i in sl)
+            ledger = self._ledger_f
+            if ledger is not None:
+                try:
+                    ledger.write(json.dumps(
+                        {"epoch": epoch, "batch": b, "indices": ids}) + "\n")
+                except ValueError:
+                    pass  # closed by the trainer's teardown mid-prefetch
             yield self.collate_fn(rows)
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        return self.iter_batches(0)
 
 
 class PrefetchIterator:
@@ -220,19 +318,34 @@ class PrefetchIterator:
 class RepeatingLoader:
     """Infinite wrapper advancing epochs (reference
     `deepspeed.utils.RepeatingLoader`, trainer_base_ds_mp.py:339, plus the
-    sampler.set_epoch call the reference does manually at :341-342)."""
+    sampler.set_epoch call the reference does manually at :341-342).
 
-    def __init__(self, loader: DataLoader):
+    `start_epoch`/`start_batch` open the stream mid-run — the O(1) resume
+    position derived from the checkpoint's data_state (train.py): the first
+    epoch yielded is `start_epoch` from batch `start_batch` on, without
+    reading a single skipped record."""
+
+    def __init__(self, loader: DataLoader, start_epoch: int = 0,
+                 start_batch: int = 0):
+        if start_epoch < 0 or start_batch < 0:
+            raise ValueError(f"start position ({start_epoch}, {start_batch}) "
+                             f"must be non-negative")
+        if start_batch >= max(len(loader), 1):
+            raise ValueError(f"start_batch {start_batch} outside the epoch "
+                             f"({len(loader)} batches); fold it into "
+                             f"start_epoch")
         self.loader = loader
-        self.epoch = 0
+        self.epoch = start_epoch
+        self._start_batch = start_batch
 
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
         while True:
             self.loader.set_epoch(self.epoch)
+            skip, self._start_batch = self._start_batch, 0
             got_any = False
-            for batch in self.loader:
+            for batch in self.loader.iter_batches(skip):
                 got_any = True
                 yield batch
-            if not got_any:
+            if not got_any and skip == 0:
                 raise ValueError("underlying loader is empty; cannot repeat")
             self.epoch += 1

@@ -75,6 +75,11 @@ class MeshConfig:
     def axis_sizes(self) -> dict[str, int]:
         return {AXIS_PP: self.pp, AXIS_DP: self.dp, AXIS_SP: self.sp, AXIS_TP: self.tp}
 
+    def describe(self) -> str:
+        """Compact layout label ("pp2xdp4xtp1xsp1") for logs, checkpoint
+        topology metadata, and the supervisor's incarnation ledger."""
+        return f"pp{self.pp}xdp{self.dp}xtp{self.tp}xsp{self.sp}"
+
     @staticmethod
     def from_world(world_size: int, pp: int = 1, tp: int = 1, sp: int = 1) -> "MeshConfig":
         """Infer dp from the device count, reference-style (world // pp)."""

@@ -7,8 +7,10 @@ Re-implements the reference's `main()` + `train()` orchestration
   from dataset length x epochs unless `max_steps` is given;
 - warm start from a converted checkpoint via `model_name_or_path`
   (reference :284 `load_module_only=True`);
-- resume detection from `checkpoint-N` dirs + dataloader fast-forward
-  (reference :451-455, :345-351);
+- resume detection from `checkpoint-N` dirs (reference :451-455); the
+  reference's dataloader fast-forward replay (:345-351) is replaced by O(1)
+  repositioning from the checkpoint's data_state (docs/RESILIENCE.md
+  "Elastic resume");
 - periodic save every `save_steps` + final save (reference :367-371);
 - rank-0 logging of lr / windowed mean loss every `logging_steps`
   (reference :360-374), extended with tokens/sec and MFU.
@@ -529,6 +531,7 @@ def _run_training(cfg: dict) -> dict:
     # (pcfg.packed switches the ring's segment streams on).
     packing = _packing_factor(cfg)
     pcfg = build_pipeline_config(cfg, mesh_cfg, manifest)
+    topology = _topology_meta(mesh, pcfg)
     # Numerics observatory (docs/OBSERVABILITY.md "Numerics"): per-stage
     # training-dynamics stats computed in-graph, anomaly detection + the
     # numerics.jsonl stream on the host. On by default — the in-graph
@@ -560,9 +563,18 @@ def _run_training(cfg: dict) -> dict:
     micro_batch = cfg.get("per_device_train_batch_size", 1)
     # with packing, the loader feeds pack_factor x examples per emitted row
     per_replica_batch = micro_batch * pcfg.num_microbatches * packing
+    data_node = cfg.get("data") or {}
     loader = DataLoader(dataset, collator, per_replica_batch=per_replica_batch,
                         dp_size=mesh_cfg.dp, seed=seed,
-                        dp_range=host_dp_shard(mesh))
+                        dp_range=host_dp_shard(mesh),
+                        quarantine_bad_records=bool(
+                            data_node.get("quarantine_bad_shards", False)),
+                        # per-sample-id ledger (elastic-resume audits); the
+                        # file covers THIS process's dp shards — process 0
+                        # only, so a pod doesn't interleave writers
+                        sample_ledger=(os.path.join(output_dir, "samples.jsonl")
+                                       if data_node.get("log_sample_ids")
+                                       and jax.process_index() == 0 else None))
     steps_per_epoch = len(loader)
     if steps_per_epoch == 0:
         raise ValueError(
@@ -623,6 +635,7 @@ def _run_training(cfg: dict) -> dict:
             params=jax.device_put(p, shard_of(state.params)),
             opt_state=jax.device_put(o, shard_of(state.opt_state)))
         logger.info("resumed full state from checkpoint-%d", resume_step)
+        _note_topology_change(mgr, resume_step, topology)
     elif cfg.get("model_name_or_path"):
         warm = CheckpointManager(cfg["model_name_or_path"])
         warm_step = warm.latest_step()
@@ -673,6 +686,15 @@ def _run_training(cfg: dict) -> dict:
         return metrics["loss"], lambda: {"lr": float(metrics["lr"]),
                                          "grad_norm": float(metrics["grad_norm"])}
 
+    data_start = (_resume_data_position(mgr, resume_step, loader,
+                                        len(dataset), seed)
+                  if resume_step else (0, 0))
+    # data-stream batches minus step count: nonzero only after a
+    # changed-global-batch remap, and every LATER checkpoint must carry the
+    # offset forward or a second resume re-trains the remapped span
+    data_delta = (data_start[0] * max(len(loader), 1)
+                  + data_start[1]) - resume_step
+
     def do_save(step, final=False):
         # async_save: periodic checkpoints return once Orbax holds host
         # copies; the disk flush + commit + off-node sync overlap the next
@@ -683,7 +705,11 @@ def _run_training(cfg: dict) -> dict:
                  opt_state=state_box[0].opt_state,
                  blocking=final or not cfg.get("async_save", False),
                  on_complete=lambda path: _sync_checkpoint(cfg, path),
-                 keep_last=cfg.get("save_total_limit"))
+                 keep_last=cfg.get("save_total_limit"),
+                 extra_meta={"topology": topology,
+                             "data_state": _data_state(step, loader,
+                                                       len(dataset), seed,
+                                                       data_delta)})
 
     do_eval = _make_evaluator(cfg, mesh, model_cfg, pcfg, stacked_template,
                               attn_fn, lambda: state_box[0].params)
@@ -691,9 +717,10 @@ def _run_training(cfg: dict) -> dict:
         final_loss, preempted_at = _train_loop(
             cfg, model_cfg, mesh, loader, seq_length,
             resume_step, end_step, do_step, do_save, do_eval,
-            extra_scalars=_packing_scalars(collator),
+            extra_scalars=_host_scalars(collator, loader),
             static_scalars={"bubble_fraction": round(pl.bubble_fraction(pcfg), 4)},
-            monitor=monitor)
+            monitor=monitor, data_start=data_start,
+            health_static={"topology": topology})
     except BaseException:
         # join the in-flight commit, but never let ITS failure replace the
         # training exception that actually killed the run
@@ -706,6 +733,121 @@ def _run_training(cfg: dict) -> dict:
     mgr.finalize()  # surface any async-commit failure on the clean path
     return _summarize(final_loss, preempted_at, end_step, steps_per_epoch,
                       output_dir)
+
+
+def _topology_meta(mesh, pcfg: "pl.PipelineConfig") -> dict:
+    """The run's topology, recorded in every checkpoint's meta.json and in
+    health.json — the source half of the elastic-restore contract
+    (docs/RESILIENCE.md "Elastic resume"): a later incarnation on a
+    different mesh reads it to explain (and log) what changed."""
+    mc = MeshConfig(pp=mesh.shape["pp"], dp=mesh.shape["dp"],
+                    tp=mesh.shape["tp"], sp=mesh.shape["sp"])
+    return {"pp": mc.pp, "dp": mc.dp, "tp": mc.tp, "sp": mc.sp,
+            "layout": mc.describe(),
+            "schedule": pcfg.schedule, "virtual_stages": pcfg.virtual_stages,
+            "process_count": jax.process_count()}
+
+
+def _data_state(step: int, loader: DataLoader, dataset_len: int,
+                seed: int, batch_delta: int = 0) -> dict:
+    """The sampler position at `step`, in dp-width-independent units: the
+    epoch permutation is a function of (seed, epoch) only, and step b
+    consumes exactly global-order positions [b*G, (b+1)*G) — so
+    consumed_samples, not any per-replica cursor, is the canonical resume
+    coordinate that survives a dp resize (docs/RESILIENCE.md).
+
+    `batch_delta`: data-stream batches minus step count, established at
+    resume (nonzero only after a changed-global-batch remap, where the step
+    counter and the data cursor diverge) — without it, a SECOND resume from
+    a checkpoint written after such a remap would reposition from step*G
+    and re-train whole spans of data."""
+    spe = max(len(loader), 1)
+    g = loader.global_batch_examples
+    batches = step + batch_delta
+    return {"epoch": batches // spe, "offset_batches": batches % spe,
+            "consumed_samples": batches * g, "shuffle_seed": seed,
+            "global_batch_examples": g, "dataset_len": dataset_len,
+            "steps_per_epoch": spe}
+
+
+def _resume_data_position(mgr: CheckpointManager, resume_step: int,
+                          loader: DataLoader, dataset_len: int,
+                          seed: int) -> tuple[int, int]:
+    """O(1) resume position (start_epoch, start_batch) for the data stream.
+
+    Replaces the seed's O(resume_step) loader replay ("minutes at scale"):
+    the checkpoint's data_state pins (seed, dataset_len, consumed samples),
+    and index arithmetic alone repositions the samplers. Checkpoints
+    without a data_state (pre-elastic format) derive the position from the
+    step count — identical to what the old replay computed, still O(1).
+    A changed global batch is remapped by consumed-sample count (exact only
+    when G is unchanged — re-trains at most one partial batch otherwise,
+    and warns); a changed shuffle seed or dataset cannot be remapped and
+    falls back to step-count positioning with a warning."""
+    spe = max(len(loader), 1)
+    g = loader.global_batch_examples
+    batches = resume_step
+    data_state = None
+    try:
+        data_state = mgr.load_meta(resume_step).get("data_state")
+    except Exception as e:  # meta vanished under us — position by step count
+        logger.warning("could not re-read checkpoint-%d meta for data_state "
+                       "(%r); positioning the loader by step count",
+                       resume_step, e)
+    if data_state:
+        if (data_state.get("shuffle_seed") != seed
+                or data_state.get("dataset_len") != dataset_len):
+            logger.warning(
+                "checkpoint data_state (seed=%s, dataset_len=%s) does not "
+                "match this run (seed=%s, dataset_len=%s); positioning by "
+                "step count — the shuffle order differs, sample-exact "
+                "continuity is not guaranteed",
+                data_state.get("shuffle_seed"), data_state.get("dataset_len"),
+                seed, dataset_len)
+        else:
+            consumed = int(data_state.get("consumed_samples", resume_step * g))
+            src_g = data_state.get("global_batch_examples")
+            if src_g not in (None, g):
+                logger.warning(
+                    "global batch changed across resume (%s -> %s examples/"
+                    "step); sample-exact continuity only holds for an "
+                    "unchanged global batch — remapping by consumed-sample "
+                    "count, re-training at most one partial batch "
+                    "(docs/RESILIENCE.md)", src_g, g)
+            batches = consumed // g
+    epoch, offset = divmod(batches, spe)
+    logger.info("O(1) data resume: step %d -> epoch %d, batch offset %d "
+                "(no loader replay)", resume_step, epoch, offset)
+    return epoch, offset
+
+
+def _note_topology_change(mgr: CheckpointManager, step: int,
+                          current: dict) -> None:
+    """Log an elastic restore: the checkpoint's recorded source topology vs
+    the mesh this incarnation runs. Purely informational — the canonical
+    layout + resharded Orbax reads make the restore itself work; what an
+    operator needs is the ledger line saying the resize happened."""
+    try:
+        source = mgr.load_meta(step).get("topology")
+    except Exception:
+        return
+    if not source:
+        return  # pre-elastic checkpoint: nothing recorded
+    changed = sorted(k for k in ("pp", "dp", "tp", "sp", "schedule",
+                                 "virtual_stages")
+                     if source.get(k) != current.get(k))
+    if changed:
+        logger.warning(
+            "elastic restore: checkpoint-%d was written at %s "
+            "(schedule=%s, v=%s); restoring onto %s (schedule=%s, v=%s) — "
+            "changed: %s. Keep the global batch unchanged for sample-exact "
+            "data continuity (docs/RESILIENCE.md)",
+            step, source.get("layout"), source.get("schedule"),
+            source.get("virtual_stages"), current.get("layout"),
+            current.get("schedule"), current.get("virtual_stages"), changed)
+    else:
+        logger.info("resume topology matches checkpoint-%d (%s)", step,
+                    current.get("layout"))
 
 
 def _restore_with_fallback(mgr: CheckpointManager, restore_fn) -> Any | None:
@@ -823,9 +965,26 @@ def _packing_scalars(collator) -> Any:
     return scalars
 
 
+def _host_scalars(collator, loader) -> Any:
+    """All host-side per-line counters: the packing drop counters plus the
+    loader's record-quarantine count (only when the quarantine is armed —
+    an always-zero column on every healthy run would be noise)."""
+    packing = _packing_scalars(collator)
+    if not loader.quarantine_bad_records:
+        return packing
+
+    def scalars():
+        out = packing() if packing else {}
+        out["data_quarantined_records"] = loader.quarantine_count
+        return out
+
+    return scalars
+
+
 def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                 do_step, do_save, do_eval=None, extra_scalars=None,
-                static_scalars=None, monitor=None) -> tuple:
+                static_scalars=None, monitor=None, data_start=(0, 0),
+                health_static=None) -> tuple:
     """The shared step/log/save/profile loop for both optimizer paths.
 
     `do_step(batch, step, fault=None) -> (loss_scalar, scalars_thunk)`; the
@@ -840,6 +999,9 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
     `monitor` (numerics.NumericsMonitor, optional) feeds the heartbeat's
     numerics fields and the metrics line's counters; its
     `NonfiniteHaltError` is turned into a final checkpoint + re-raise here.
+    `data_start` ((epoch, batch), from _resume_data_position) opens the
+    repeating loader at the O(1) resume position; `health_static`
+    (optional dict, e.g. the run topology) rides on every health.json write.
     """
     output_dir = cfg["output_dir"]
     # Scalars are replicated across processes: process 0 writes for the pod
@@ -874,7 +1036,8 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
     heartbeat = (trace.Heartbeat(output_dir, clock,
                                  interval=cfg.get("health_interval", 10.0),
                                  extra=monitor.health_fields
-                                 if monitor is not None else None)
+                                 if monitor is not None else None,
+                                 static=health_static)
                  if jax.process_index() == 0 else None)
     peak_bytes, peak_src = trace.device_peak_bytes()
     logger.info("device memory telemetry: %s (%s)",
@@ -897,11 +1060,13 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
             profile_window = (lo, hi)
     trace_active = False
 
-    it: Iterator = iter(RepeatingLoader(loader))
-    if resume_step:  # dataloader fast-forward (reference :345-351) — minutes
-        with trace.span("data_wait", fast_forward=resume_step):  # at scale
-            for _ in range(resume_step):
-                next(it)
+    # O(1) data resume (docs/RESILIENCE.md "Elastic resume"): the loader
+    # opens directly at (epoch, batch) by index arithmetic — the reference's
+    # batch-by-batch fast-forward replay (reference :345-351, "minutes at
+    # scale") and its PR 1 descendant are gone.
+    start_epoch, start_batch = data_start
+    it: Iterator = iter(RepeatingLoader(loader, start_epoch=start_epoch,
+                                        start_batch=start_batch))
     it = PrefetchIterator(it, depth=cfg.get("prefetch_depth", 2))
 
     # Preemption-aware save (SURVEY.md §5.3): on a preemption notice —
@@ -1053,6 +1218,7 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
             logger.info("profiler trace (early exit) written to %s/profile", output_dir)
         if monitor is not None:
             monitor.close()
+        loader.close_ledger()  # repeated in-process runs must not leak fds
         writer.close()
         if heartbeat is not None:
             heartbeat.stop()  # kills the daemon on every exit path; write()
@@ -1182,11 +1348,13 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
         host.load_state_dict({"m": m, "v": v, "step_count": step_count})
         return resume
 
+    topology = _topology_meta(mesh, pcfg)
     restored = (_restore_with_fallback(mgr, _restore_offload)
                 if cfg.get("resume", True) else None)
     if restored is not None:
         resume_step = restored
         logger.info("resumed offloaded state from checkpoint-%d", resume_step)
+        _note_topology_change(mgr, resume_step, topology)
     elif cfg.get("model_name_or_path"):
         warm = CheckpointManager(cfg["model_name_or_path"])
         warm_step = warm.latest_step()
@@ -1291,12 +1459,23 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
                               **{k: round(v, 2)
                                  for k, v in host.last_timings.items()}}
 
+    data_start = (_resume_data_position(mgr, resume_step, loader,
+                                        len(dataset), cfg.get("seed", 42))
+                  if resume_step else (0, 0))
+    data_delta = (data_start[0] * max(len(loader), 1)
+                  + data_start[1]) - resume_step
+
     def do_save(step, final=False):
         # the offload save streams from host masters that the next optimizer
         # step mutates IN PLACE — it must block regardless of async_save
         barrier("pre-save")
         path = mgr.save_offload(step, host, manifest, model_cfg,
-                                keep_last=cfg.get("save_total_limit"))
+                                keep_last=cfg.get("save_total_limit"),
+                                extra_meta={"topology": topology,
+                                            "data_state": _data_state(
+                                                step, loader, len(dataset),
+                                                cfg.get("seed", 42),
+                                                data_delta)})
         _sync_checkpoint(cfg, path)
 
     do_eval = _make_evaluator(cfg, mesh, model_cfg, pcfg, stacked_template,
@@ -1304,8 +1483,9 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
     final_loss, preempted_at = _train_loop(
         cfg, model_cfg, mesh, loader, seq_length,
         resume_step, end_step, do_step, do_save, do_eval,
-        extra_scalars=_packing_scalars(collator),
+        extra_scalars=_host_scalars(collator, loader),
         static_scalars={"bubble_fraction": round(pl.bubble_fraction(pcfg), 4)},
-        monitor=monitor)
+        monitor=monitor, data_start=data_start,
+        health_static={"topology": topology})
     return _summarize(final_loss, preempted_at, end_step, len(loader),
                       output_dir)

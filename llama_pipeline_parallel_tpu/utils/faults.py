@@ -52,11 +52,18 @@ Ops:
             (utils/numerics.poison_grads) — the chaos input for the
             numerics observatory's same-step detect/skip/localize contract.
             Extra field `stage` (default 0) picks the stage.
+  device_loss  (`device_probe` site only) `fire()` returns
+            "device_loss:<devices>" and the caller (the supervisor's
+            restart-time device probe) behaves as if only `devices` chips
+            were available — the chaos input for the elastic fallback
+            ladder (docs/RESILIENCE.md "Elastic resume"). Extra field
+            `devices` (default 0) is the REMAINING device count.
 
 Sites threaded through the codebase: `storage_write` (checkpoint file
 I/O), `ckpt_commit` (between array durability and the meta/tag write),
 `barrier` (host_barrier entry), `data_read` (per-record dataset reads),
-`step` (top of every training step).
+`step` (top of every training step), `device_probe` (the supervisor's
+available-device probe before each incarnation launch).
 """
 
 from __future__ import annotations
@@ -75,8 +82,10 @@ logger = get_logger(__name__)
 
 ENV_PLAN = "LPT_FAULT_PLAN"
 
-_OPS = ("error", "stall", "slow", "corrupt", "die", "grad_nonfinite")
-_SITES = ("storage_write", "ckpt_commit", "barrier", "data_read", "step")
+_OPS = ("error", "stall", "slow", "corrupt", "die", "grad_nonfinite",
+        "device_loss")
+_SITES = ("storage_write", "ckpt_commit", "barrier", "data_read", "step",
+          "device_probe")
 
 
 class InjectedFault(OSError):
@@ -92,7 +101,7 @@ class _Rule:
     def __init__(self, spec: dict, index: int, rng_seed: int):
         unknown = set(spec) - {"site", "op", "match", "at_step", "after",
                                "times", "every", "p", "marker", "seconds",
-                               "signal", "stage"}
+                               "signal", "stage", "devices"}
         if unknown:
             raise FaultPlanError(f"fault rule #{index}: unknown keys {sorted(unknown)}")
         try:
@@ -115,6 +124,10 @@ class _Rule:
         self.marker = spec.get("marker")
         self.seconds = float(spec.get("seconds", 0.0))
         self.stage = int(spec.get("stage", 0))
+        self.devices = int(spec.get("devices", 0))
+        if self.devices < 0:
+            raise FaultPlanError(
+                f"fault rule #{index}: devices must be >= 0, got {self.devices}")
         self.signal = spec.get("signal", "SIGKILL")
         if not hasattr(_signal, self.signal):
             raise FaultPlanError(f"fault rule #{index}: unknown signal {self.signal!r}")
@@ -197,6 +210,10 @@ class FaultInjector:
                 logger.warning("%s: poisoning stage %d gradients nonfinite",
                                desc, rule.stage)
                 verdict = f"grad_nonfinite:{rule.stage}"
+            elif rule.op == "device_loss":
+                logger.warning("%s: simulating device loss (%d remaining)",
+                               desc, rule.devices)
+                verdict = f"device_loss:{rule.devices}"
             elif rule.op == "die":
                 # raw stderr write then a hard kill: the point is an unclean
                 # death (no atexit, no finally) — exactly what a preempted

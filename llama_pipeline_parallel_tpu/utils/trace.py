@@ -306,13 +306,18 @@ class Heartbeat:
 
     def __init__(self, output_dir: str, clock: RunClock | None = None,
                  interval: float = 10.0, min_write_interval: float = 1.0,
-                 extra: dict | None = None):
+                 extra: dict | None = None, static: dict | None = None):
         os.makedirs(output_dir, exist_ok=True)
         self.path = os.path.join(output_dir, "health.json")
         self._clock = clock
         self._interval = interval
         self._min_write = min_write_interval
         self._extra = extra or {}
+        # run constants (e.g. the mesh topology) repeated on every write so
+        # an external watchdog can read the incarnation's layout from
+        # health.json alone; distinct from `extra`, which is a LIVE dict
+        # whose owner mutates it between writes
+        self._static = static or {}
         self._lock = threading.Lock()        # guards _state
         self._write_lock = threading.Lock()  # serializes whole-file writes
         self._state: dict[str, Any] = {"pid": os.getpid(), "last_step": None,
@@ -337,6 +342,7 @@ class Heartbeat:
         with self._lock:
             state = dict(self._state)
         state["time"] = time.time()
+        state.update(self._static)
         state.update(self._extra)
         if self._clock is not None:
             snap = self._clock.snapshot()

@@ -1,0 +1,737 @@
+"""Elastic resume: topology-change-tolerant restore + O(1) data recovery.
+
+Fast lane (tier-1, CI "Elastic resume" gate): the dp-width-invariant
+global-sample-index contract, O(1) loader repositioning (zero record reads
+for the skipped prefix, asserted on an instrumented loader), the
+cross-topology restore grid (dp2->dp1, dp1->dp2, pp4->pp2, interleaved
+v=2 -> flat; bit-identical params/opt_state), record quarantine, the
+supervisor's fallback ladder, and the resize-aware goodput ledger.
+Slow lane (round gate): the full chaos run — a fault plan kills the
+trainer mid-run, the supervisor restarts it onto a halved-dp layout, and
+the per-sample-id ledger proves zero dropped / zero duplicated samples
+across the resize.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
+from llama_pipeline_parallel_tpu.data.loader import (
+    DataLoader,
+    RepeatingLoader,
+    ShardedSampler,
+)
+from llama_pipeline_parallel_tpu.models.llama import model as llama
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+from llama_pipeline_parallel_tpu.optim import OptimizerConfig, make_optimizer
+from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+from llama_pipeline_parallel_tpu.parallel import train_step as ts
+from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
+from llama_pipeline_parallel_tpu.utils import faults
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries_then_clean_plan(monkeypatch):
+    monkeypatch.setenv("LPT_RETRY_BASE_DELAY_S", "0.001")
+    monkeypatch.setenv("LPT_RETRY_MAX_DELAY_S", "0.01")
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv("LPT_DEVICE_COUNT", raising=False)
+    yield
+    faults.configure(None)
+
+
+# ---------------------------------------------------------------------------
+# the deterministic data contract
+# ---------------------------------------------------------------------------
+
+def _consumed_positions(dataset_len, dp, per_replica, steps, seed=11):
+    """Dataset indices consumed by the first `steps` global batches across
+    ALL replicas at this dp width."""
+    out = set()
+    for rank in range(dp):
+        s = ShardedSampler(dataset_len, dp, rank=rank, seed=seed)
+        idx = s.indices()
+        out.update(int(i) for i in idx[: steps * per_replica])
+    return out
+
+
+def test_global_sample_index_is_dp_width_invariant():
+    """Step b consumes exactly global-order positions [b*G, (b+1)*G) of the
+    epoch permutation for ANY dp width — the property that makes a dp
+    resize drop/duplicate zero samples when G is unchanged."""
+    L, G, steps = 130, 16, 4
+    ref = _consumed_positions(L, 4, G // 4, steps)
+    assert _consumed_positions(L, 2, G // 2, steps) == ref
+    assert _consumed_positions(L, 1, G, steps) == ref
+    # and it IS the permutation prefix: G*steps positions of the epoch order
+    perm = np.random.RandomState(11 * 131071 + 0).permutation(L)
+    assert ref == set(int(i) for i in perm[: G * steps])
+
+
+def test_steps_per_epoch_is_dp_width_invariant():
+    """(L // dp) // per_replica == L // G — epoch boundaries survive a
+    resize with an unchanged global batch."""
+    for L in (64, 130, 257, 4096):
+        for dp, b in ((1, 8), (2, 4), (4, 2), (8, 1)):
+            loader = DataLoader(dataset=list(range(L)),
+                                collate_fn=lambda rows: {"x": np.asarray(rows)},
+                                per_replica_batch=b, dp_size=dp)
+            assert len(loader) == L // (dp * b)
+            assert loader.global_batch_examples == dp * b
+
+
+def _int_loader(n=64, batch=4, dp=1, **kw):
+    return DataLoader(dataset=list(range(n)),
+                      collate_fn=lambda rows: {"x": np.asarray(rows)},
+                      per_replica_batch=batch, dp_size=dp, seed=3, **kw)
+
+
+def test_repeating_loader_start_position_matches_replay():
+    """Opening the stream at (epoch, batch) yields exactly what consuming
+    and discarding that prefix yields — the O(1) fast path is bit-identical
+    to the replay it replaced."""
+    spe = len(_int_loader())  # 16
+    skip = spe + 5  # into epoch 1
+    replay = iter(RepeatingLoader(_int_loader()))
+    for _ in range(skip):
+        next(replay)
+    fast = iter(RepeatingLoader(_int_loader(), start_epoch=skip // spe,
+                                start_batch=skip % spe))
+    for _ in range(spe):  # crosses the epoch-2 boundary too
+        np.testing.assert_array_equal(next(fast)["x"], next(replay)["x"])
+
+
+def test_skipped_prefix_costs_zero_record_reads():
+    loader = _int_loader()
+    skipped = sum(1 for _ in loader.iter_batches(start_batch=14))
+    assert skipped == 2
+    assert loader.records_read == 2 * 4  # only the yielded batches read
+
+
+def test_repeating_loader_start_validation():
+    with pytest.raises(ValueError, match="outside the epoch"):
+        RepeatingLoader(_int_loader(), start_batch=99)
+    with pytest.raises(ValueError, match="non-negative"):
+        RepeatingLoader(_int_loader(), start_epoch=-1)
+
+
+def test_sample_ledger_rows(tmp_path):
+    path = str(tmp_path / "samples.jsonl")
+    loader = _int_loader(n=16, batch=4, dp=2, sample_ledger=path)
+    it = iter(RepeatingLoader(loader))
+    for _ in range(3):
+        next(it)
+    rows = [json.loads(l) for l in open(path)]
+    assert [(r["epoch"], r["batch"]) for r in rows] == [(0, 0), (0, 1), (1, 0)]
+    # each row holds one global batch's ids: dp*per_replica of them, distinct
+    for r in rows:
+        assert len(r["indices"]) == 8 and len(set(r["indices"])) == 8
+
+
+# ---------------------------------------------------------------------------
+# record quarantine (data.quarantine_bad_shards)
+# ---------------------------------------------------------------------------
+
+def test_persistently_bad_record_is_fatal_by_default(monkeypatch):
+    monkeypatch.setenv("LPT_RETRY_MAX_ATTEMPTS", "2")
+    faults.configure({"faults": [
+        {"site": "data_read", "op": "error", "match": "7"}]})
+    with pytest.raises(faults.InjectedFault):
+        list(_int_loader(n=16, batch=4))
+
+
+def test_quarantine_skips_bad_record_and_counts(monkeypatch):
+    """quarantine_bad_records: a record that stays broken past the retry
+    budget is skipped (deterministic substitute) instead of killing the
+    run, and the counter records the loss."""
+    monkeypatch.setenv("LPT_RETRY_MAX_ATTEMPTS", "2")
+    faults.configure({"faults": [
+        {"site": "data_read", "op": "error", "match": "7"}]})
+    loader = _int_loader(n=16, batch=4, quarantine_bad_records=True)
+    batches = list(loader)
+    assert len(batches) == 4  # full epoch, full batches
+    got = sorted(np.concatenate([b["x"] for b in batches]).tolist())
+    assert 7 not in got and len(got) == 16
+    assert loader.quarantine_count == 1
+    # the bad record stays quarantined: the next epoch substitutes with no
+    # further retry storm against index 7
+    fired_before = faults.active().stats()[0]["fired"]
+    loader.set_epoch(1)
+    assert len(list(loader)) == 4
+    assert faults.active().stats()[0]["fired"] == fired_before
+    assert loader.quarantine_count == 1
+
+
+def test_quarantine_gives_up_when_everything_is_bad(monkeypatch):
+    monkeypatch.setenv("LPT_RETRY_MAX_ATTEMPTS", "1")
+    faults.configure({"faults": [{"site": "data_read", "op": "error"}]})
+    loader = _int_loader(n=8, batch=4, quarantine_bad_records=True)
+    with pytest.raises(OSError, match="every record is quarantined"):
+        list(loader)
+
+
+# ---------------------------------------------------------------------------
+# cross-topology restore grid: bit-identical params + opt_state
+# ---------------------------------------------------------------------------
+
+def _trained_state(cfg, pp, dp, virtual_stages=1, steps=1):
+    manifest = StageManifest.for_config(cfg, pp, virtual_stages=virtual_stages)
+    mesh = make_mesh(MeshConfig(pp=pp, dp=dp))
+    stacked = pl.stack_stages(llama.init_params(jax.random.PRNGKey(0), cfg),
+                              manifest)
+    pcfg = pl.PipelineConfig(
+        num_stages=pp, num_microbatches=2,
+        schedule="interleaved_1f1b" if virtual_stages > 1 else "1f1b",
+        virtual_stages=virtual_stages)
+    tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-3,
+                                               total_steps=50, warmup_steps=5))
+    state = ts.init_train_state(stacked, tx, mesh)
+    step = ts.make_train_step(mesh, cfg, pcfg, tx, sched, stacked)
+    rng = np.random.RandomState(0)
+    B = dp * 2 * 2
+    ids = rng.randint(3, cfg.vocab_size, size=(B, 16)).astype(np.int32)
+    batch = {"input_ids": np.asarray(ids),
+             "attention_mask": np.ones((B, 16), np.int32),
+             "position_ids": np.broadcast_to(np.arange(16, dtype=np.int32),
+                                             (B, 16)).copy(),
+             "labels": np.asarray(ids)}
+    for _ in range(steps):
+        state, _ = step(state, batch)
+    return state, manifest, tx
+
+
+def _canonical(tree, manifest):
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import _canonicalize_moments
+
+    return _canonicalize_moments(tree, manifest, to_canonical=True)
+
+
+@pytest.mark.parametrize("src,dst", [
+    # (pp, dp, virtual_stages) — every resize class the ladder can take
+    ((2, 2, 1), (2, 1, 1)),   # dp shrink
+    ((2, 1, 1), (2, 2, 1)),   # dp grow
+    ((4, 2, 1), (2, 2, 1)),   # pp resize
+    ((2, 2, 2), (2, 2, 1)),   # interleaved v=2 -> flat
+], ids=["dp2-dp1", "dp1-dp2", "pp4-pp2", "v2-flat"])
+def test_cross_topology_restore_grid(tmp_path, devices, src, dst):
+    """A checkpoint written at one topology restores BIT-IDENTICALLY
+    (canonical view of params and the full optimizer state) onto another —
+    dp shrink/grow, pp resize, and schedule change, on the fused path."""
+    cfg = LlamaConfig.tiny()
+    state, man_src, tx = _trained_state(cfg, *src)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, state.params, man_src, cfg, opt_state=state.opt_state,
+             extra_meta={"topology": {"pp": src[0], "dp": src[1],
+                                      "virtual_stages": src[2]}})
+
+    pp_d, dp_d, v_d = dst
+    man_dst = StageManifest.for_config(cfg, pp_d, virtual_stages=v_d)
+    mesh_d = make_mesh(MeshConfig(pp=pp_d, dp=dp_d))
+    tmpl = pl.stack_stages(llama.init_params(jax.random.PRNGKey(1), cfg),
+                           man_dst)
+    state_d = ts.init_train_state(tmpl, tx, mesh_d)
+    params_d, opt_d, step = mgr.load(1, state_d.params, state_d.opt_state,
+                                     man_dst)
+    assert step == 1
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        pl.unstack_stages(params_d, man_dst),
+        pl.unstack_stages(state.params, man_src))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        _canonical(opt_d, man_dst), _canonical(state.opt_state, man_src))
+
+
+# ---------------------------------------------------------------------------
+# trainer-level elastic resume: dp resize, ledger continuity, O(1) reads
+# ---------------------------------------------------------------------------
+
+def _trainer_cfg(out, dp=2, accum=2, **kw):
+    cfg = {
+        "output_dir": str(out),
+        "mesh": {"pp": 2, "dp": dp},
+        "model": {"preset": "tiny", "dtype": "float32"},
+        "dataset": {"synthetic": True, "seq_length": 16,
+                    "pseudo_dataset_len": 128},
+        "data": {"log_sample_ids": True},
+        "seed": 7,
+        "per_device_train_batch_size": 2,
+        "gradient_accumulation_steps": accum,
+        "max_steps": 6,
+        "total_steps": 6,
+        "learning_rate": 1e-3,
+        "warmup_steps": 1,
+        "logging_steps": 1,
+        "save_steps": 0,
+        "save_final": True,
+        "attention": "exact",
+        "prefetch_depth": 1,
+    }
+    cfg.update(kw)
+    return cfg
+
+
+def _dedup_ledger(out, steps=6):
+    """{(epoch, batch): sorted ids} for the TRAINED batches, last row wins —
+    re-trained batches from a resumed incarnation overwrite the discarded
+    first attempt, and rows the prefetch producer read past end_step
+    (nondeterministic lookahead, never trained) are excluded."""
+    rows = [json.loads(l) for l in open(os.path.join(str(out), "samples.jsonl"))]
+    return {(r["epoch"], r["batch"]): sorted(r["indices"]) for r in rows
+            if r["epoch"] == 0 and r["batch"] < steps}
+
+
+def test_trainer_dp_shrink_resume_ledger_and_loss(tmp_path, devices):
+    """The acceptance path in-process: train at dp2, resume the checkpoint
+    at dp1 with the SAME global batch (accum doubled). The per-sample-id
+    ledger proves the resized run consumed exactly the batches an unresized
+    run consumes (zero dropped, zero duplicated), and the final loss
+    matches the unresized run."""
+    from llama_pipeline_parallel_tpu.train import run_training
+
+    ref = run_training(_trainer_cfg(tmp_path / "ref"))  # dp2 straight to 6
+    out = tmp_path / "resized"
+    run_training(_trainer_cfg(out, max_steps=3))        # dp2, ckpt-3
+    resumed = run_training(_trainer_cfg(out, dp=1, accum=4))  # dp1, G kept
+    assert resumed["final_step"] == 6
+    # the checkpoint written at dp2 restored onto dp1 and trained on: its
+    # meta records the source topology for the post-mortem trail
+    mgr = CheckpointManager(str(out))
+    meta = mgr.load_meta(6)
+    assert meta["topology"]["dp"] == 1
+    assert meta["data_state"]["consumed_samples"] == 6 * 8
+    # ledger continuity across the resize: same consumed ids per batch slot
+    assert _dedup_ledger(out) == _dedup_ledger(tmp_path / "ref")
+    ids = [i for v in _dedup_ledger(out).values() for i in v]
+    assert len(ids) == len(set(ids)) == 6 * 8  # one epoch slice, no dups
+    np.testing.assert_allclose(resumed["final_loss"], ref["final_loss"],
+                               rtol=1e-5)
+
+
+def test_trainer_resume_is_o1_in_record_reads(tmp_path, devices):
+    """Resume no longer iterates the loader resume_step times: the resumed
+    incarnation reads only the batches it trains (+ bounded prefetch
+    lookahead), and the first batch read is EXACTLY the resume offset's
+    sampler slice."""
+    from llama_pipeline_parallel_tpu.train import run_training
+
+    out = tmp_path / "o1"
+    run_training(_trainer_cfg(out, max_steps=4, data={}))
+
+    reads = []
+    orig = DataLoader._fetch
+
+    def counting(self, index):
+        reads.append(int(index))
+        return orig(self, index)
+
+    try:
+        DataLoader._fetch = counting
+        resumed = run_training(_trainer_cfg(out, data={}))
+    finally:
+        DataLoader._fetch = orig
+    assert resumed["final_step"] == 6
+    # 2 trained batches + <= 3 prefetched-ahead batches, 8 records each;
+    # the old replay would have read >= (4 + 2) * 8 = 48 before lookahead
+    assert 2 * 8 <= len(reads) <= 5 * 8
+    # position check: the first 8 reads are batch 4 of epoch 0
+    expected = set()
+    for rank in range(2):
+        s = ShardedSampler(128, 2, rank=rank, seed=7)
+        expected.update(int(i) for i in s.indices()[4 * 4:5 * 4])
+    assert set(reads[:8]) == expected
+
+
+@pytest.mark.slow
+def test_trainer_pp_resize_and_schedule_change_resume(tmp_path, devices):
+    """pp4 -> pp2 and interleaved v=2 -> flat through the FULL trainer:
+    the resized resume reaches end_step with the reference loss (global
+    batch unchanged; pp/schedule do not touch the data contract)."""
+    from llama_pipeline_parallel_tpu.train import run_training
+
+    ref = run_training(_trainer_cfg(tmp_path / "r2"))
+    # pp4 start, pp2 finish
+    out = tmp_path / "pp"
+    run_training(_trainer_cfg(out, mesh={"pp": 4, "dp": 2}, max_steps=3))
+    resumed = run_training(_trainer_cfg(out))
+    assert resumed["final_step"] == 6
+    assert _dedup_ledger(out) == _dedup_ledger(tmp_path / "r2")
+    np.testing.assert_allclose(resumed["final_loss"], ref["final_loss"],
+                               rtol=1e-5)
+    # interleaved v=2 start, flat finish. The reference is a STRAIGHT v=2
+    # run, not the flat one above: init_params_sharded's in-jit RNG is
+    # sharding-layout-dependent (pre-existing quirk, see PR 4's notes), so
+    # a v=2 run starts from different init params than a flat run — the
+    # restore itself is what this leg isolates (steps 3-6 continue from the
+    # same restored state; PR 4 pinned the schedules bit-equal).
+    ref_v = run_training(_trainer_cfg(tmp_path / "rv",
+                                      pipeline_schedule="interleaved_1f1b",
+                                      virtual_stages=2))
+    out = tmp_path / "v"
+    run_training(_trainer_cfg(out, max_steps=3,
+                              pipeline_schedule="interleaved_1f1b",
+                              virtual_stages=2))
+    resumed = run_training(_trainer_cfg(out))
+    assert resumed["final_step"] == 6
+    assert _dedup_ledger(out) == _dedup_ledger(tmp_path / "rv")
+    np.testing.assert_allclose(resumed["final_loss"], ref_v["final_loss"],
+                               rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_trainer_offload_dp_shrink_resume(tmp_path, devices):
+    """The host-offload optimizer path reshards across a dp resize too:
+    dp2-written masters/moments resume at dp1 and match the unresized run."""
+    from llama_pipeline_parallel_tpu.train import run_training
+
+    base = dict(optimizer_offload=True, learning_rate=1e-2)
+    ref = run_training(_trainer_cfg(tmp_path / "oref", **base))
+    out = tmp_path / "o"
+    run_training(_trainer_cfg(out, max_steps=3, **base))
+    resumed = run_training(_trainer_cfg(out, dp=1, accum=4, **base))
+    assert resumed["final_step"] == 6
+    assert _dedup_ledger(out) == _dedup_ledger(tmp_path / "oref")
+    np.testing.assert_allclose(resumed["final_loss"], ref["final_loss"],
+                               rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# meta.json data_state / topology plumbing + inspect_ckpt
+# ---------------------------------------------------------------------------
+
+def test_resume_position_derivation(tmp_path, devices):
+    """_resume_data_position: exact from data_state; remapped (with a
+    warning) on a changed global batch; step-count fallback on a seed
+    mismatch or a pre-elastic checkpoint."""
+    from llama_pipeline_parallel_tpu.train import _resume_data_position
+
+    cfg = LlamaConfig.tiny()
+    manifest = StageManifest.for_config(cfg, 1)
+    stacked = pl.stack_stages(llama.init_params(jax.random.PRNGKey(0), cfg),
+                              manifest)
+    mgr = CheckpointManager(str(tmp_path))
+    loader = _int_loader(n=64, batch=4)  # spe=16, G=4
+
+    # exact: consumed 72 samples at G=4 -> batch 18 -> epoch 1, offset 2
+    mgr.save(18, stacked, manifest, cfg, extra_meta={"data_state": {
+        "epoch": 1, "offset_batches": 2, "consumed_samples": 72,
+        "shuffle_seed": 3, "global_batch_examples": 4, "dataset_len": 64}})
+    assert _resume_data_position(mgr, 18, loader, 64, 3) == (1, 2)
+
+    # G changed 8 -> 4: remap by consumed count (144 // 4 = 36 -> (2, 4))
+    mgr.save(19, stacked, manifest, cfg, extra_meta={"data_state": {
+        "epoch": 1, "offset_batches": 2, "consumed_samples": 144,
+        "shuffle_seed": 3, "global_batch_examples": 8, "dataset_len": 64}})
+    assert _resume_data_position(mgr, 19, loader, 64, 3) == (2, 4)
+
+    # seed mismatch: fall back to step-count positioning
+    mgr.save(20, stacked, manifest, cfg, extra_meta={"data_state": {
+        "epoch": 9, "offset_batches": 9, "consumed_samples": 999,
+        "shuffle_seed": 999, "global_batch_examples": 4, "dataset_len": 64}})
+    assert _resume_data_position(mgr, 20, loader, 64, 3) == (1, 4)
+
+    # pre-elastic checkpoint (no data_state): step-count positioning
+    mgr.save(21, stacked, manifest, cfg)
+    assert _resume_data_position(mgr, 21, loader, 64, 3) == (1, 5)
+
+
+def test_data_state_carries_remap_delta_forward():
+    """A checkpoint written AFTER a changed-global-batch resume must record
+    the true data cursor, not step*G: the remap shifted the data stream
+    ahead of the step counter, and a SECOND resume from such a checkpoint
+    would otherwise re-train the whole remapped span."""
+    from llama_pipeline_parallel_tpu.train import _data_state
+
+    loader = _int_loader(n=64, batch=4)  # G=4, spe=16
+    # resumed at step 18 from a G=8 checkpoint: consumed 144 -> data batch
+    # 36, so the data stream runs 18 batches ahead of the step counter
+    ds = _data_state(20, loader, 64, 3, batch_delta=18)
+    assert ds["consumed_samples"] == (20 + 18) * 4
+    assert (ds["epoch"], ds["offset_batches"]) == (2, 6)
+    # unchanged-G runs have delta 0 and the original step*G semantics
+    ds = _data_state(20, loader, 64, 3)
+    assert ds["consumed_samples"] == 80
+    assert (ds["epoch"], ds["offset_batches"]) == (1, 4)
+
+
+def test_inspect_ckpt_reports_data_state_and_topology(tmp_path, devices):
+    from inspect_ckpt import describe
+
+    cfg = LlamaConfig.tiny()
+    manifest = StageManifest.for_config(cfg, 1)
+    stacked = pl.stack_stages(llama.init_params(jax.random.PRNGKey(0), cfg),
+                              manifest)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(5, stacked, manifest, cfg, extra_meta={
+        "topology": {"pp": 2, "dp": 2, "tp": 1, "sp": 1,
+                     "layout": "pp2xdp2xtp1xsp1", "schedule": "1f1b",
+                     "virtual_stages": 1, "process_count": 1},
+        "data_state": {"epoch": 0, "offset_batches": 5,
+                       "consumed_samples": 40, "shuffle_seed": 42,
+                       "global_batch_examples": 8, "dataset_len": 256}})
+    out = describe(str(tmp_path))
+    assert out["checkpoint"]["source_topology"]["layout"] == "pp2xdp2xtp1xsp1"
+    assert out["checkpoint"]["data_state"]["consumed_samples"] == 40
+
+    # pre-elastic checkpoints degrade to a labeled absence, not a KeyError
+    mgr.save(6, stacked, manifest, cfg)
+    out = describe(str(tmp_path), step=6)
+    assert "pre-elastic" in out["checkpoint"]["source_topology"]
+    assert "pre-elastic" in out["checkpoint"]["data_state"]
+
+
+# ---------------------------------------------------------------------------
+# supervisor fallback ladder
+# ---------------------------------------------------------------------------
+
+def _sup():
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import supervisor
+
+    return supervisor
+
+
+def test_parse_ladder_validation(tmp_path):
+    supervisor = _sup()
+    assert supervisor.parse_ladder(None) is None
+    rungs = supervisor.parse_ladder(
+        '[{"devices": 8, "overrides": ["mesh.dp=2"], "name": "dp2"}]')
+    assert rungs[0].devices == 8 and rungs[0].label() == "dp2"
+    path = tmp_path / "ladder.json"
+    path.write_text('[{"devices": 4}]')
+    assert supervisor.parse_ladder(f"@{path}")[0].label() == "base"
+    with pytest.raises(ValueError, match="non-empty JSON list"):
+        supervisor.parse_ladder("[]")
+    with pytest.raises(ValueError, match="devices"):
+        supervisor.parse_ladder('[{"overrides": []}]')
+    with pytest.raises(ValueError, match="unknown keys"):
+        supervisor.parse_ladder('[{"devices": 2, "device": 3}]')
+
+
+_CHILD = r"""
+import json, os, sys
+argv_log, marker = sys.argv[1], sys.argv[2]
+with open(argv_log, "a") as f:
+    f.write(json.dumps(sys.argv[3:]) + "\n")
+if not os.path.exists(marker):
+    open(marker, "w").close()
+    sys.exit(1)   # first incarnation crashes
+sys.exit(0)
+"""
+
+
+def test_supervisor_walks_ladder_on_device_loss(tmp_path, monkeypatch):
+    """Crash -> restart probes the (faulted) device count, drops a rung,
+    appends the rung's overrides to the command, and records the resize in
+    the incarnation ledger. A stale health.json from BEFORE the run must
+    not label either incarnation's topology."""
+    supervisor = _sup()
+    out = str(tmp_path / "run")
+    os.makedirs(out)
+    with open(os.path.join(out, "health.json"), "w") as f:
+        json.dump({"time": __import__("time").time(),
+                   "topology": {"layout": "stale-from-a-dead-run"}}, f)
+    argv_log = str(tmp_path / "argv.jsonl")
+    marker = str(tmp_path / "crashed.marker")
+    monkeypatch.setenv("LPT_DEVICE_COUNT", "8")
+    faults.configure({"faults": [
+        {"site": "device_probe", "op": "device_loss", "devices": 4,
+         "after": 1}]})
+    ladder = supervisor.parse_ladder(json.dumps([
+        {"name": "dp2", "devices": 8, "overrides": ["mesh.dp=2"]},
+        {"name": "dp1", "devices": 4,
+         "overrides": ["mesh.dp=1", "gradient_accumulation_steps=4"]}]))
+    sup = supervisor.Supervisor(
+        [sys.executable, "-c", _CHILD, argv_log, marker],
+        supervisor.SupervisorConfig(output_dir=out, max_restarts=2,
+                                    hang_timeout_s=60, poll_s=0.05,
+                                    ladder=ladder))
+    assert sup.run() == 0
+    argvs = [json.loads(l) for l in open(argv_log)]
+    assert argvs[0] == ["mesh.dp=2"]
+    assert argvs[1] == ["mesh.dp=1", "gradient_accumulation_steps=4"]
+    ledger = [json.loads(l) for l in open(os.path.join(out,
+                                                       "incarnations.jsonl"))]
+    assert [r["outcome"] for r in ledger] == ["crash", "clean"]
+    assert [r["layout"] for r in ledger] == ["dp2", "dp1"]
+    assert [r["devices"] for r in ledger] == [8, 4]
+    assert [r["resized"] for r in ledger] == [False, True]
+    # the fake child never wrote health.json: the pre-run stale file must
+    # not vouch a topology onto these incarnations
+    assert [r["trainer_topology"] for r in ledger] == [None, None]
+
+
+def test_supervisor_malformed_device_count_falls_through(tmp_path, monkeypatch):
+    """Garbage in LPT_DEVICE_COUNT degrades to the next probe (--probe-cmd),
+    never a supervisor traceback."""
+    supervisor = _sup()
+    monkeypatch.setenv("LPT_DEVICE_COUNT", "8 chips")
+    ladder = supervisor.parse_ladder('[{"devices": 4, "name": "dp1"}]')
+    sup = supervisor.Supervisor(
+        [sys.executable, "-c", "import sys; sys.exit(0)"],
+        supervisor.SupervisorConfig(output_dir=str(tmp_path / "run"),
+                                    poll_s=0.05, ladder=ladder,
+                                    probe_cmd="echo 4"))
+    assert sup.run() == 0
+    ledger = [json.loads(l) for l in
+              open(os.path.join(str(tmp_path / "run"), "incarnations.jsonl"))]
+    assert ledger[0]["devices"] == 4 and ledger[0]["layout"] == "dp1"
+
+
+def test_supervisor_seeds_last_layout_from_persisted_ledger(tmp_path):
+    """A resize across a SUPERVISOR restart (fresh process, same
+    output_dir) must still be recorded: _last_layout seeds from the last
+    ledger row, not from in-memory state."""
+    supervisor = _sup()
+    out = str(tmp_path / "run")
+    os.makedirs(out)
+    with open(os.path.join(out, "incarnations.jsonl"), "w") as f:
+        f.write(json.dumps({"incarnation": 0, "outcome": "crash",
+                            "layout": "dp4"}) + "\n")
+    sup = supervisor.Supervisor(
+        ["true"], supervisor.SupervisorConfig(output_dir=out))
+    assert sup._last_layout == "dp4"
+    # fresh dir / torn tail degrade to None, never a traceback
+    sup2 = supervisor.Supervisor(
+        ["true"], supervisor.SupervisorConfig(output_dir=str(tmp_path / "n")))
+    assert sup2._last_layout is None
+    with open(os.path.join(out, "incarnations.jsonl"), "a") as f:
+        f.write('{"torn')
+    sup3 = supervisor.Supervisor(
+        ["true"], supervisor.SupervisorConfig(output_dir=out))
+    assert sup3._last_layout is None
+
+
+def test_supervisor_aborts_when_no_rung_fits(tmp_path, monkeypatch):
+    supervisor = _sup()
+    argv_log = str(tmp_path / "argv.jsonl")
+    monkeypatch.setenv("LPT_DEVICE_COUNT", "2")
+    ladder = supervisor.parse_ladder('[{"devices": 4, "name": "dp1"}]')
+    sup = supervisor.Supervisor(
+        [sys.executable, "-c", _CHILD, argv_log, str(tmp_path / "m")],
+        supervisor.SupervisorConfig(output_dir=str(tmp_path / "run"),
+                                    poll_s=0.05, ladder=ladder))
+    assert sup.run() == 4
+    assert not os.path.exists(argv_log)  # nothing was ever launched
+
+
+# ---------------------------------------------------------------------------
+# goodput report: topology labels + resize badput bucket
+# ---------------------------------------------------------------------------
+
+def test_goodput_report_attributes_resize_badput(tmp_path):
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    from goodput_report import incarnation_summary
+
+    rows = [
+        {"incarnation": 0, "outcome": "crash", "duration_s": 30.0,
+         "start": 0.0, "end": 30.0, "layout": "dp4", "devices": 32,
+         "resized": False},
+        {"incarnation": 1, "outcome": "crash", "duration_s": 8.0,
+         "start": 32.0, "end": 40.0, "layout": "dp2", "devices": 16,
+         "resized": True,
+         "trainer_topology": {"layout": "pp4xdp2xtp1xsp1"}},
+        {"incarnation": 2, "outcome": "clean", "duration_s": 100.0,
+         "start": 41.0, "end": 141.0, "layout": "dp2", "devices": 16,
+         "resized": False},
+    ]
+    with open(tmp_path / "incarnations.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    summary = incarnation_summary(str(tmp_path))
+    assert summary["resize_events"] == 1
+    # the crash that forced the resize (30 s) + the relaunch gap (2 s)
+    assert summary["resize_lost_seconds"] == pytest.approx(32.0)
+    assert summary["lost_seconds"] == pytest.approx(38.0)
+    labels = [l["layout"] for l in summary["layouts"]]
+    assert labels == ["dp4", "pp4xdp2xtp1xsp1", "dp2"]  # trainer view wins
+    assert summary["layouts"][1]["resized"] is True
+
+
+# ---------------------------------------------------------------------------
+# the full chaos run: die mid-run, supervised restart onto a halved-dp mesh
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_resize_supervised_resume_zero_sample_loss(tmp_path):
+    """The acceptance chaos test: a fault plan SIGKILLs the trainer at step
+    3 and makes the next device probe report half the chips; the supervisor
+    walks the ladder to a dp1 layout (global batch preserved through
+    doubled accumulation), the resume restores the last verified checkpoint
+    onto the smaller mesh, and the per-sample-id ledger proves zero dropped
+    and zero duplicated samples across the resize."""
+    out = str(tmp_path / "chaos")
+    ref = str(tmp_path / "straight")
+    env_base = {**os.environ,
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+                "LPT_RETRY_BASE_DELAY_S": "0.01",
+                "LPT_DEVICE_COUNT": "8"}
+
+    def train_cmd(output_dir):
+        return [sys.executable, "train.py", "--config", "conf/tiny_smoke.yaml",
+                "--platform", "cpu", f"output_dir={output_dir}",
+                "max_steps=6", "total_steps=6", "save_steps=2",
+                "logging_steps=1", "save_final=true", "attention=exact",
+                "data.log_sample_ids=true"]
+
+    ladder = [
+        {"name": "dp2", "devices": 8, "overrides": []},
+        {"name": "dp1", "devices": 4,
+         "overrides": ["mesh.dp=1", "gradient_accumulation_steps=4"]}]
+    plan = {"faults": [
+        {"site": "step", "op": "die", "at_step": 3,
+         "marker": os.path.join(out, "fault.fired")},
+        {"site": "device_probe", "op": "device_loss", "devices": 4,
+         "after": 1}]}
+    sup = subprocess.run(
+        [sys.executable, "tools/supervisor.py", "--output-dir", out,
+         "--max-restarts", "2", "--hang-timeout-s", "600", "--poll-s", "0.2",
+         "--layout-ladder", json.dumps(ladder), "--"] + train_cmd(out),
+        cwd=_REPO, env={**env_base, faults.ENV_PLAN: json.dumps(plan)},
+        capture_output=True, text=True, timeout=540)
+    assert sup.returncode == 0, \
+        f"supervisor failed:\n{sup.stdout[-3000:]}\n{sup.stderr[-3000:]}"
+
+    ledger = [json.loads(l)
+              for l in open(os.path.join(out, "incarnations.jsonl"))]
+    assert [r["outcome"] for r in ledger] == ["crash", "clean"]
+    assert [r["layout"] for r in ledger] == ["dp2", "dp1"]
+    assert ledger[1]["resized"] is True
+    # the resumed incarnation's own health.json carried the dp1 topology
+    assert ledger[1]["trainer_topology"]["dp"] == 1
+
+    # the last verified checkpoint restored onto the halved mesh and the
+    # run finished; meta records the resized topology + exact data state
+    mgr = CheckpointManager(out)
+    assert mgr.latest_step() == 6
+    mgr.verify(6)
+    meta = mgr.load_meta(6)
+    assert meta["topology"]["dp"] == 1
+    assert meta["data_state"]["consumed_samples"] == 6 * 8
+
+    straight = subprocess.run(train_cmd(ref), cwd=_REPO, env=env_base,
+                              capture_output=True, text=True, timeout=360)
+    assert straight.returncode == 0, straight.stdout[-3000:]
+
+    # zero dropped, zero duplicated: the surviving training trajectory
+    # consumed exactly the sample ids the unresized run consumed
+    assert _dedup_ledger(out) == _dedup_ledger(ref)
+    ids = [i for v in _dedup_ledger(out).values() for i in v]
+    assert len(ids) == len(set(ids)) == 6 * 8
+
+    def last_loss(d):
+        lines = [json.loads(l) for l in open(os.path.join(d, "metrics.jsonl"))]
+        return [l["loss"] for l in lines if "loss" in l][-1]
+
+    np.testing.assert_allclose(last_loss(out), last_loss(ref), rtol=1e-5)

@@ -151,7 +151,15 @@ def test_incarnation_ledger_summary(run_dir, capsys):
     rep = goodput_report.build_report(str(run_dir))
     inc = rep["incarnations"]
     assert inc == {"incarnations": 3, "restarts": 2, "crashes": 1, "hangs": 1,
-                   "lost_seconds": pytest.approx(50.5), "last_outcome": "clean"}
+                   "lost_seconds": pytest.approx(50.5), "last_outcome": "clean",
+                   "resize_events": 0, "resize_lost_seconds": 0.0,
+                   "layouts": [
+                       {"incarnation": 0, "outcome": "crash", "layout": None,
+                        "devices": None, "resized": False},
+                       {"incarnation": 1, "outcome": "hang", "layout": None,
+                        "devices": None, "resized": False},
+                       {"incarnation": 2, "outcome": "clean", "layout": None,
+                        "devices": None, "resized": False}]}
     goodput_report.print_report(rep)
     out = capsys.readouterr().out
     assert "incarnations (supervisor ledger)" in out and "2 restart(s)" in out

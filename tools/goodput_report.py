@@ -151,11 +151,25 @@ def load_health(output_dir: str) -> tuple[dict, str]:
     return health, "ok"
 
 
+def _incarnation_label(row: dict) -> str | None:
+    """Topology label for one ledger row: the trainer's own health.json
+    topology when it ran long enough to write one, else the supervisor's
+    ladder-rung label, else None (an inelastic pre-elastic ledger)."""
+    topo = row.get("trainer_topology")
+    if isinstance(topo, dict) and topo.get("layout"):
+        return topo["layout"]
+    return row.get("layout")
+
+
 def incarnation_summary(output_dir: str) -> dict | None:
     """Roll-up of the supervisor's goodput ledger (incarnations.jsonl, one
     row per launch — tools/supervisor.py), or None when the run was never
     supervised. Restart badput = wall seconds spent in incarnations that
-    did not end cleanly."""
+    did not end cleanly; `resize_lost_seconds` is a SIBLING bucket — the
+    failed-incarnation time that forced each topology resize plus the
+    probe/relaunch gap before the resized launch (the gap is wall-clock
+    lost_seconds never counts) — so elastic downgrades are visible next to
+    plain restarts."""
     rows = load_jsonl(os.path.join(output_dir, "incarnations.jsonl"))
     rows = [r for r in rows if isinstance(r, dict)]
     if not rows:
@@ -164,13 +178,33 @@ def incarnation_summary(output_dir: str) -> dict | None:
     # checkpointed + exited cleanly — productive time, not restart badput
     failed = [r for r in rows
               if r.get("outcome") not in ("clean", "supervisor_stopped", None)]
+    resize_lost = 0.0
+    resizes = 0
+    for prev, cur in zip(rows, rows[1:]):
+        if not cur.get("resized"):
+            continue
+        resizes += 1
+        # the failed incarnation that forced this resize, plus the
+        # probe/relaunch gap before the resized one came up
+        if prev in failed:
+            resize_lost += _num(prev.get("duration_s")) or 0.0
+        start, end = _num(cur.get("start")), _num(prev.get("end"))
+        if start is not None and end is not None:
+            resize_lost += max(start - end, 0.0)
     return {
         "incarnations": len(rows),
         "restarts": max(len(rows) - 1, 0),
         "crashes": sum(1 for r in failed if r.get("outcome") == "crash"),
         "hangs": sum(1 for r in failed if r.get("outcome") == "hang"),
         "lost_seconds": sum(_num(r.get("duration_s")) or 0.0 for r in failed),
+        "resize_events": resizes,
+        "resize_lost_seconds": round(resize_lost, 3),
         "last_outcome": rows[-1].get("outcome"),
+        "layouts": [{"incarnation": r.get("incarnation"),
+                     "outcome": r.get("outcome"),
+                     "layout": _incarnation_label(r),
+                     "devices": r.get("devices"),
+                     "resized": bool(r.get("resized"))} for r in rows],
     }
 
 
@@ -246,6 +280,20 @@ def print_report(rep: dict) -> None:
               f"restart(s): {inc['crashes']} crash(es), {inc['hangs']} "
               f"hang(s); {inc['lost_seconds']:.1f} s lost to failed "
               f"incarnations; last outcome: {inc['last_outcome']}")
+        if inc.get("resize_events"):
+            # crash duration + relaunch gap around each resize — the gap is
+            # not part of lost_seconds (which counts only failed-incarnation
+            # wall time), so this is a sibling bucket, not a subset
+            print(f"  {inc['resize_events']} topology resize(s); "
+                  f"{inc['resize_lost_seconds']:.1f} s of crash + relaunch "
+                  f"downtime bought a smaller layout (resize badput)")
+        if any(l.get("layout") for l in inc.get("layouts", [])):
+            for l in inc["layouts"]:
+                mark = " <- resized" if l.get("resized") else ""
+                devices = (f", {l['devices']} device(s)"
+                           if l.get("devices") is not None else "")
+                print(f"    #{l['incarnation']}: {l['layout'] or '?'}"
+                      f"{devices}  [{l['outcome']}]{mark}")
 
     num = rep.get("numerics")
     if num:

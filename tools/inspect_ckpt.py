@@ -68,6 +68,15 @@ def describe(root: str, step: int | None = None) -> dict:
                 if not meta.get("has_optimizer_state") else
                 meta.get("opt_layout", "fused (optax)")),
             "format_version": meta.get("format_version"),
+            # elastic-resume metadata (docs/RESILIENCE.md "Elastic resume"):
+            # the mesh the checkpoint was written at (any topology restores
+            # it — this is provenance, not a constraint) and the sampler
+            # position an O(1) resume repositions from
+            "source_topology": meta.get("topology")
+                               or "none (pre-elastic format)",
+            "data_state": meta.get("data_state")
+                          or "none (pre-elastic format; resume positions "
+                             "by step count)",
             "items_on_disk": sorted(
                 d for d in os.listdir(mgr.step_dir(inspect_step))
                 if os.path.isdir(os.path.join(mgr.step_dir(inspect_step), d))),

@@ -277,6 +277,64 @@ def calibrate() -> dict:
     return out
 
 
+def resume_compat(cfg: dict) -> dict | None:
+    """Elastic-resume preflight (docs/RESILIENCE.md "Elastic resume"): when
+    the config's output_dir already holds a checkpoint this run would
+    resume, compare its recorded source topology and data contract against
+    the config — BEFORE burning a compile on a resume that will warn about
+    (or silently accept) a changed global batch. Returns None when there is
+    nothing to resume; never fails the preflight (topology changes are
+    legal — that is the point of elastic restore)."""
+    import json as _json
+
+    out_dir = cfg.get("output_dir")
+    if not out_dir or not os.path.isdir(out_dir) or not cfg.get("resume", True):
+        return None
+    # read meta.json directly (no CheckpointManager: the preflight must not
+    # create dirs or spin up Orbax just to peek at a marker file)
+    latest = None
+    try:
+        import re as _re
+
+        for d in os.listdir(out_dir):
+            m = _re.match(r"^checkpoint-(\d+)$", d)
+            if m and os.path.isfile(os.path.join(out_dir, d, "meta.json")):
+                latest = max(latest or 0, int(m.group(1)))
+        if latest is None:
+            return None
+        with open(os.path.join(out_dir, f"checkpoint-{latest}",
+                               "meta.json")) as f:
+            meta = _json.load(f)
+    except (OSError, ValueError):
+        return None  # torn/corrupt meta: the trainer's quarantine handles it
+    mesh = dict(cfg.get("mesh") or {})
+    current = {"pp": int(mesh.get("pp", 1)), "dp": int(mesh.get("dp", 1)),
+               "tp": int(mesh.get("tp", 1)), "sp": int(mesh.get("sp", 1)),
+               "schedule": cfg.get("pipeline_schedule", "1f1b"),
+               "virtual_stages": int(cfg.get("virtual_stages", 1) or 1)}
+    report: dict = {"resume_step": latest}
+    source = meta.get("topology")
+    if source:
+        changed = sorted(k for k in current if source.get(k) != current[k])
+        report["source_topology"] = source.get("layout", source)
+        report["topology_changed"] = changed or "no"
+    data_state = meta.get("data_state")
+    if data_state:
+        packing = int(cfg.get("packing_factor", 1) or 1)
+        g_now = (current["dp"] * int(cfg.get("per_device_train_batch_size", 1))
+                 * int(cfg.get("gradient_accumulation_steps", 1)) * packing)
+        g_ckpt = data_state.get("global_batch_examples")
+        report["global_batch_examples"] = {"checkpoint": g_ckpt,
+                                           "config": g_now}
+        report["data_contract"] = (
+            "exact (O(1) reposition, zero dropped/duplicated samples)"
+            if g_ckpt == g_now else
+            "REMAPPED — global batch changed; re-trains at most one partial "
+            "batch and shifts the lr-schedule/epoch mapping "
+            "(docs/RESILIENCE.md)")
+    return report
+
+
 def _run_all(patterns: list[str], hbm_gb: float, overrides: list[str]) -> None:
     """Preflight every config matching `patterns` in its own subprocess (each
     needs a different virtual device count, fixed at jax import) and print a
@@ -381,6 +439,11 @@ def main(argv: list[str] | None = None) -> None:
     report = preflight(cfg, args.hbm_gb)
     for k, v in report.items():
         print(f"  {k}: {v}")
+    resume = resume_compat(cfg)
+    if resume:
+        print("resume preflight (elastic — docs/RESILIENCE.md):")
+        for k, v in resume.items():
+            print(f"  {k}: {v}")
     if not report["fits"]:
         print(f"preflight FAIL: per-device peak {report['per_device_peak_gib']} GiB "
               f"exceeds the {args.hbm_gb} GiB budget")

@@ -27,13 +27,40 @@ Behavior:
   restart loop (the pod-preemption path: the trainer saves, everyone
   exits).
 
+Elastic fallback ladder (docs/RESILIENCE.md "Elastic resume"): with
+--layout-ladder, each (re)launch first probes the available device count
+and walks the ladder — an ordered list of layouts, each with the minimum
+devices it needs and the config overrides that select it:
+
+  --layout-ladder '[{"name": "dp4", "devices": 32, "overrides": []},
+                    {"name": "dp2", "devices": 16,
+                     "overrides": ["mesh.dp=2",
+                                   "gradient_accumulation_steps=16"]},
+                    {"name": "dp1", "devices": 8,
+                     "overrides": ["mesh.dp=1",
+                                   "gradient_accumulation_steps=32"]}]'
+
+(inline JSON or @/path/to/ladder.json). The first rung whose `devices`
+fits launches; its overrides are appended to the training command, the
+trainer's elastic restore reshards the checkpoint onto the new mesh, and
+the resize is recorded in the incarnation ledger (`layout`, `devices`,
+`resized` fields). Keep every rung's GLOBAL batch identical (compensate a
+dp shrink with more accumulation steps) for sample-exact data continuity.
+The probe order is: an injected `device_probe` fault verdict (chaos
+tests) > $LPT_DEVICE_COUNT > --probe-cmd > `python -c "import jax;
+print(jax.device_count())"` in a fresh process. When no rung fits, the
+supervisor aborts with exit 4 — running a layout the hardware cannot hold
+would just crash-loop.
+
 Exit codes: 0 child completed; 2 restart budget exhausted; 3 crash loop;
-when the supervisor itself is stopped, the child's own exit code (a
-signal death maps to the shell convention 128+N).
+4 no ladder rung fits the available devices; when the supervisor itself
+is stopped, the child's own exit code (a signal death maps to the shell
+convention 128+N).
 
 Resume correctness is the trainer's job (checkpoint integrity + fallback,
-loader fast-forward); the supervisor only guarantees a fresh incarnation
-gets launched with the same command line.
+O(1) data repositioning); the supervisor only guarantees a fresh
+incarnation gets launched with a command line whose layout the surviving
+hardware can actually run.
 """
 
 from __future__ import annotations
@@ -47,6 +74,8 @@ import subprocess
 import sys
 import time
 from typing import Any
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 LEDGER_NAME = "incarnations.jsonl"
 HEALTH_NAME = "health.json"
@@ -68,6 +97,47 @@ def read_health(output_dir: str) -> dict | None:
 
 
 @dataclasses.dataclass
+class LayoutRung:
+    """One rung of the elastic fallback ladder: the minimum device count
+    this layout needs and the config overrides that select it."""
+
+    devices: int
+    overrides: tuple = ()
+    name: str = ""
+
+    def label(self) -> str:
+        return self.name or (" ".join(self.overrides) or "base")
+
+
+def parse_ladder(spec: str | None) -> list[LayoutRung] | None:
+    """--layout-ladder value: inline JSON or `@/path/to/ladder.json`, a list
+    of {"devices": int, "overrides": [str, ...], "name": str?} objects,
+    ordered best-first."""
+    if not spec:
+        return None
+    raw = spec.strip()
+    if raw.startswith("@"):
+        with open(raw[1:]) as f:
+            data = json.load(f)
+    else:
+        data = json.loads(raw)
+    if not isinstance(data, list) or not data:
+        raise ValueError("--layout-ladder must be a non-empty JSON list")
+    rungs = []
+    for i, entry in enumerate(data):
+        if not isinstance(entry, dict) or "devices" not in entry:
+            raise ValueError(f"ladder rung #{i} must be an object with a "
+                             f"'devices' key, got {entry!r}")
+        unknown = set(entry) - {"devices", "overrides", "name"}
+        if unknown:
+            raise ValueError(f"ladder rung #{i}: unknown keys {sorted(unknown)}")
+        rungs.append(LayoutRung(devices=int(entry["devices"]),
+                                overrides=tuple(entry.get("overrides") or ()),
+                                name=str(entry.get("name", ""))))
+    return rungs
+
+
+@dataclasses.dataclass
 class SupervisorConfig:
     output_dir: str
     max_restarts: int = 5
@@ -76,6 +146,8 @@ class SupervisorConfig:
     crash_loop_threshold: int = 3
     crash_loop_window_s: float = 120.0
     poll_s: float = 1.0
+    ladder: list | None = None      # LayoutRungs, best-first (None = inelastic)
+    probe_cmd: str | None = None    # shell command printing the device count
 
 
 class Supervisor:
@@ -93,6 +165,18 @@ class Supervisor:
         self._stop_signal: int | None = None
         self._ledger_path = os.path.join(cfg.output_dir, LEDGER_NAME)
         os.makedirs(cfg.output_dir, exist_ok=True)
+        # previous incarnation's rung label, seeded from the persisted
+        # ledger so a resize across a SUPERVISOR restart (new process, same
+        # output_dir) is still recorded as resized
+        self._last_layout: str | None = self._last_ledger_layout()
+
+    def _last_ledger_layout(self) -> str | None:
+        try:
+            with open(self._ledger_path) as f:
+                lines = [l for l in f if l.strip()]
+            return json.loads(lines[-1]).get("layout") if lines else None
+        except (OSError, ValueError, AttributeError):
+            return None  # fresh run, torn tail, or a pre-elastic ledger
 
     # -- ledger ------------------------------------------------------------
 
@@ -112,6 +196,59 @@ class Supervisor:
                 child.send_signal(sig)
             except OSError:
                 pass
+
+    # -- elastic layout selection --------------------------------------------
+
+    def _probe_devices(self, incarnation: int) -> int | None:
+        """Available device count for the next launch, or None when unknown
+        (treated as "assume the full topology"). Probe order: an injected
+        `device_probe` fault verdict (chaos plans simulate losing chips at
+        restart) > $LPT_DEVICE_COUNT > --probe-cmd > this interpreter
+        importing jax in a fresh process (the dead child's devices are
+        released by then)."""
+        try:
+            from llama_pipeline_parallel_tpu.utils import faults
+
+            verdict = faults.fire("device_probe",
+                                  tag=f"incarnation-{incarnation}")
+        except Exception:
+            verdict = None
+        if verdict and verdict.startswith("device_loss:"):
+            n = int(verdict.split(":", 1)[1])
+            print(f"[supervisor] injected device loss: probe reports {n} "
+                  f"device(s)", flush=True)
+            return n
+        env_count = (self.env or os.environ).get("LPT_DEVICE_COUNT")
+        if env_count:
+            try:
+                return int(env_count)
+            except ValueError:
+                # the supervisor exists to survive faults — garbage in the
+                # env falls through to the next probe, never a traceback
+                print(f"[supervisor] ignoring malformed LPT_DEVICE_COUNT="
+                      f"{env_count!r}", flush=True)
+        probe_cmd = self.cfg.probe_cmd or (
+            f"{sys.executable} -c 'import jax; print(jax.device_count())'")
+        try:
+            out = subprocess.run(probe_cmd, shell=True, env=self.env,
+                                 capture_output=True, text=True, timeout=300)
+            return int(out.stdout.strip().splitlines()[-1])
+        except Exception as e:
+            print(f"[supervisor] device probe failed ({e!r}); assuming the "
+                  f"full topology", flush=True)
+            return None
+
+    def _select_rung(self, incarnation: int
+                     ) -> tuple["LayoutRung | None", int | None]:
+        """(rung, probed device count) for this launch; (None, n) when no
+        rung fits. Without a ladder: (None, None) — inelastic, base command."""
+        if not self.cfg.ladder:
+            return None, None
+        available = self._probe_devices(incarnation)
+        for rung in self.cfg.ladder:
+            if available is None or available >= rung.devices:
+                return rung, available
+        return None, available
 
     # -- one incarnation -----------------------------------------------------
 
@@ -149,11 +286,13 @@ class Supervisor:
                 pass
             child.wait()
 
-    def _run_once(self, incarnation: int) -> dict:
+    def _run_once(self, incarnation: int, cmd: list[str] | None = None,
+                  layout: dict | None = None) -> dict:
+        cmd = cmd if cmd is not None else self.cmd
         started = _now()
-        print(f"[supervisor] incarnation {incarnation}: {' '.join(self.cmd)}",
+        print(f"[supervisor] incarnation {incarnation}: {' '.join(cmd)}",
               flush=True)
-        child = subprocess.Popen(self.cmd, env=self.env)
+        child = subprocess.Popen(cmd, env=self.env)
         self._child = child
         outcome = "clean"
         while True:
@@ -178,6 +317,14 @@ class Supervisor:
         self._child = None
         ended = _now()
         health = read_health(self.cfg.output_dir) or {}
+        # a health.json the DEAD PREVIOUS incarnation wrote must not label
+        # this one (same staleness rule as _heartbeat_age): an incarnation
+        # that died before its first heartbeat gets None fields, not the
+        # old topology/step
+        try:
+            fresh = float(health.get("time", 0.0)) > started
+        except (TypeError, ValueError):
+            fresh = False
         rec = {
             "incarnation": incarnation,
             "start": started,
@@ -187,7 +334,12 @@ class Supervisor:
             "outcome": outcome,
             "last_step": health.get("last_step"),
             "goodput": health.get("goodput"),
+            # the trainer's own view of its mesh (health.json `topology`,
+            # written by the Heartbeat) — the ledger's authoritative label
+            "trainer_topology": health.get("topology") if fresh else None,
         }
+        if layout is not None:
+            rec.update(layout)
         self._log_incarnation(rec)
         print(f"[supervisor] incarnation {incarnation} ended: "
               f"outcome={outcome} exit={rc} last_step={rec['last_step']}",
@@ -206,7 +358,28 @@ class Supervisor:
         try:
             failures: list[dict] = []  # consecutive non-clean incarnations
             for incarnation in range(self.cfg.max_restarts + 1):
-                rec = self._run_once(incarnation)
+                rung, available = self._select_rung(incarnation)
+                cmd, layout = self.cmd, None
+                if self.cfg.ladder:
+                    if rung is None:
+                        print(f"[supervisor] no ladder rung fits "
+                              f"{available} available device(s); aborting "
+                              f"(a layout the hardware cannot hold would "
+                              f"only crash-loop)", flush=True)
+                        return 4
+                    cmd = self.cmd + list(rung.overrides)
+                    resized = (self._last_layout is not None
+                               and rung.label() != self._last_layout)
+                    if resized:
+                        print(f"[supervisor] topology resize: "
+                              f"{self._last_layout} -> {rung.label()} "
+                              f"({available} device(s) available)",
+                              flush=True)
+                    layout = {"layout": rung.label(), "devices": available,
+                              "overrides": list(rung.overrides),
+                              "resized": resized}
+                    self._last_layout = rung.label()
+                rec = self._run_once(incarnation, cmd=cmd, layout=layout)
                 if rec["outcome"] == "clean":
                     return 0
                 if rec["outcome"] == "supervisor_stopped":
@@ -255,6 +428,16 @@ def main(argv: list[str] | None = None) -> int:
                         "crash loop (default 120)")
     p.add_argument("--poll-s", type=float, default=1.0,
                    help="watchdog poll interval (default 1)")
+    p.add_argument("--layout-ladder", default=None,
+                   help="elastic fallback ladder: JSON list of {devices, "
+                        "overrides, name} rungs, best-first (inline or "
+                        "@/path/to/ladder.json); each launch probes the "
+                        "available devices and runs the first rung that "
+                        "fits (exit 4 when none does)")
+    p.add_argument("--probe-cmd", default=None,
+                   help="shell command printing the available device count "
+                        "(default: this interpreter importing jax in a "
+                        "fresh process); only used with --layout-ladder")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="the training command, after `--`")
     args = p.parse_args(argv)
@@ -265,7 +448,8 @@ def main(argv: list[str] | None = None) -> int:
         output_dir=args.output_dir, max_restarts=args.max_restarts,
         hang_timeout_s=args.hang_timeout_s, grace_s=args.grace_s,
         crash_loop_threshold=args.crash_loop_threshold,
-        crash_loop_window_s=args.crash_loop_window_s, poll_s=args.poll_s))
+        crash_loop_window_s=args.crash_loop_window_s, poll_s=args.poll_s,
+        ladder=parse_ladder(args.layout_ladder), probe_cmd=args.probe_cmd))
     return sup.run()
 
 
