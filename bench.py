@@ -397,6 +397,67 @@ def main() -> None:
                 "dt": dt, "tokens_per_step": 8 * long_seq, "headline": False,
                 "detail": {"seq": long_seq}}
 
+        # Serving microbench (BENCH_SERVING=0 skips): prefill TTFT + steady-
+        # state per-token decode latency at fixed batch through the REAL
+        # continuous-batching engine (serve/engine.py), i.e. the numbers
+        # docs/SERVING.md's SLOs are made of. Same fail-fast posture as the
+        # other extras: a failure here reports, never wedges the headline
+        # (the up-front device probe already ran).
+        if os.environ.get("BENCH_SERVING", "1") != "0":
+            try:
+                from llama_pipeline_parallel_tpu.models.llama.decode import (
+                    GenerationConfig,
+                )
+                from llama_pipeline_parallel_tpu.serve import (
+                    ServeConfig,
+                    ServeEngine,
+                    ServeRequest,
+                )
+
+                slots = int(os.environ.get("BENCH_SERVE_SLOTS", "8"))
+                p_len = min(128, seq)
+                decode_steps = int(os.environ.get("BENCH_SERVE_STEPS", "32"))
+                budget = decode_steps + 8  # no row finishes mid-timing
+                eng = ServeEngine(
+                    pl.unstack_stages(stacked, manifest), cfg,
+                    ServeConfig(max_slots=slots, max_len=p_len + budget + 1,
+                                prompt_buckets=(p_len,),
+                                max_queue=4 * slots))
+                rs = np.random.RandomState(0)
+                prompt = rs.randint(3, cfg.vocab_size, (p_len,)).tolist()
+
+                def req(n):
+                    return ServeRequest(input_ids=prompt,
+                                        gen=GenerationConfig(max_new_tokens=n))
+
+                # warmup: compile prefill + decode_step off the clock
+                eng.submit(req(2))
+                eng.drain(timeout_s=600)
+                # TTFT: one cold request against a warm engine
+                eng.submit(req(2))
+                eng.drain(timeout_s=600)
+                ttft = eng.stats.ttft[-1]
+                results[f"extra:serve-ttft,p={p_len}"] = {
+                    "dt": ttft, "tokens_per_step": p_len, "headline": False,
+                    "detail": {"ttft_ms": round(1000 * ttft, 2)}}
+                # steady-state decode: all slots occupied, timed ticks
+                for _ in range(slots):
+                    eng.submit(req(budget))
+                eng.step()  # admissions + first tick
+                t0 = time.perf_counter()
+                for _ in range(decode_steps):
+                    eng.step()
+                dt = (time.perf_counter() - t0) / decode_steps
+                results[f"extra:serve-decode,bs={slots}"] = {
+                    "dt": dt, "tokens_per_step": slots, "headline": False,
+                    "detail": {"per_token_ms": round(1000 * dt / slots, 3),
+                               "step_ms": round(1000 * dt, 2),
+                               "slots": slots}}
+                eng.shutdown()
+            except Exception as e:
+                print(f"bench serving rows failed: {e!r}", file=sys.stderr,
+                      flush=True)
+
     summary = report()
     watchdog.cancel()
     if summary is None:

@@ -49,6 +49,7 @@ class GenerationConfig:
     max_new_tokens: int = 64
     temperature: float = 0.0     # 0 -> greedy
     top_k: int = 0               # 0 -> full distribution
+    top_p: float = 1.0           # nucleus mass; 1.0 -> no nucleus filter
     eos_token_id: int | None = None
     pad_token_id: int = 0        # emitted after a row hits eos
 
@@ -56,6 +57,8 @@ class GenerationConfig:
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the decode loop "
                              "always emits the prefill-sampled token)")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
 
 
 def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
@@ -133,6 +136,25 @@ def forward_with_cache(params: Params, input_ids: jnp.ndarray, cache: dict,
     return llama.lm_head(params, x, cfg), {"k": new_k, "v": new_v}
 
 
+def _top_p_mask(logits: jnp.ndarray, top_p) -> jnp.ndarray:
+    """Nucleus filter: keep the smallest descending-sorted prefix whose
+    cumulative probability reaches `top_p`; everything else to -inf.
+
+    Keep rule is `cumulative mass BEFORE the token < top_p`, so the argmax
+    always survives (a top_p below the top token's own probability degrades
+    to greedy, never to an empty support). Shape-agnostic over leading dims
+    — the serving path runs it per row with a traced scalar `top_p`, and
+    both paths share this exact arithmetic so their tokens match bit-for-bit.
+    """
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    before = jnp.cumsum(probs, axis=-1) - probs
+    keep = before < top_p
+    cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(logits < cutoff, -jnp.inf, logits)
+
+
 def _sample(logits: jnp.ndarray, gen: GenerationConfig, rng: jax.Array) -> jnp.ndarray:
     """[b, V] fp32 logits -> [b] int32 next tokens."""
     if gen.temperature <= 0.0:
@@ -141,7 +163,40 @@ def _sample(logits: jnp.ndarray, gen: GenerationConfig, rng: jax.Array) -> jnp.n
     if gen.top_k > 0:
         kth = jax.lax.top_k(logits, gen.top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if gen.top_p < 1.0:
+        logits = _top_p_mask(logits, gen.top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def _sample_row(logits: jnp.ndarray, temperature, top_k, top_p,
+                key: jax.Array) -> jnp.ndarray:
+    """[V] logits -> scalar token, with PER-REQUEST knobs as traced values.
+
+    The serving batch mixes requests with different GenerationConfigs, so
+    the static branches of `_sample` become data: greedy is selected by
+    `where(temperature > 0)`, the top-k threshold is the k-th largest VALUE
+    (the same element `lax.top_k` finds, read off a descending sort), and
+    the nucleus filter is the shared `_top_p_mask`. Every arithmetic path
+    mirrors `_sample` exactly, which is what makes a slot-served request
+    reproduce an independent `generate()` call token-for-token.
+    """
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.where(temperature > 0.0, temperature, 1.0)
+    l = logits / safe_t
+    sorted_desc = jnp.sort(l, axis=-1)[..., ::-1]
+    kth = sorted_desc[jnp.clip(top_k, 1, vocab) - 1]
+    l = jnp.where((top_k > 0) & (l < kth), -jnp.inf, l)
+    l = jnp.where(top_p < 1.0, _top_p_mask(l, top_p), l)
+    sampled = jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
+def sample_rowwise(logits: jnp.ndarray, temperature: jnp.ndarray,
+                   top_k: jnp.ndarray, top_p: jnp.ndarray,
+                   keys: jnp.ndarray) -> jnp.ndarray:
+    """[b, V] logits + [b] per-row knobs + [b, 2] keys -> [b] tokens."""
+    return jax.vmap(_sample_row)(logits, temperature, top_k, top_p, keys)
 
 
 @partial(jax.jit, static_argnames=("cfg", "gen"))
@@ -202,3 +257,136 @@ def generate(params: Params, input_ids: jnp.ndarray, attention_mask: jnp.ndarray
         done = done | (token == gen.eos_token_id)
     tokens = jnp.concatenate([tokens, last[None]], axis=0)
     return {"tokens": tokens.T, "done": done}
+
+
+# -- continuous-batching entry points (serve/) -------------------------------
+#
+# `generate()` owns a whole batch cradle-to-grave: one shared prompt bucket,
+# one scalar write position, cache re-initialized per call. Serving needs the
+# same kernels with the batch axis reinterpreted as SLOTS that requests join
+# and leave independently: the cache is allocated ONCE at [max_slots,
+# max_len], `prefill_prompt` produces a row to splice in, and `decode_step`
+# advances every slot one token with PER-ROW write positions, rope positions,
+# rng chains, and sampling knobs. The arithmetic per row is identical to
+# generate()'s — serve/engine.py leans on that for its token-parity contract.
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_len"))
+def prefill_prompt(params: Params, input_ids: jnp.ndarray,
+                   attention_mask: jnp.ndarray, cfg: LlamaConfig,
+                   max_len: int) -> dict:
+    """Prefill LEFT-padded prompts into fresh max_len-sized cache rows.
+
+    input_ids/attention_mask: [b, P] (P = the prompt bucket; per-request
+    length variation lives in the left padding, so one compile per bucket).
+    Returns {"logits": [b, V] fp32 last-position logits, "cache": k/v
+    [L, b, max_len, kv_h, hd] with prompt kv at [0, P), "kv_mask":
+    [b, max_len], "next_pos": [b] rope position of the first generated
+    token}. The next write position is P — uniform, the caller knows it
+    statically.
+    """
+    b, prompt_len = input_ids.shape
+    if prompt_len > max_len:
+        raise ValueError(f"prompt bucket {prompt_len} exceeds cache max_len "
+                         f"{max_len}")
+    mask = attention_mask.astype(jnp.int32)
+    positions = jnp.clip(jnp.cumsum(mask, axis=1) - 1, 0, None).astype(jnp.int32)
+    cache = init_kv_cache(cfg, b, max_len)
+    kv_mask = jnp.pad(mask, ((0, 0), (0, max_len - prompt_len)))
+    logits, cache = forward_with_cache(
+        params, input_ids, cache, positions, 0, kv_mask, cfg, causal=True,
+        last_only=True)
+    return {"logits": logits[:, -1], "cache": cache, "kv_mask": kv_mask,
+            "next_pos": positions[:, -1] + 1}
+
+
+def _layer_decode_rowwise(layer: Params, x: jnp.ndarray, cache_k: jnp.ndarray,
+                          cache_v: jnp.ndarray, write_pos: jnp.ndarray,
+                          kv_mask: jnp.ndarray, cos: jnp.ndarray,
+                          sin: jnp.ndarray, cfg: LlamaConfig
+                          ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """`_layer_forward_cached`'s decode branch with write_pos: [b] — each
+    slot writes its own cache position (requests at different depths share
+    one decode tick), via a vmapped per-row dynamic_update_slice."""
+    b, s, d = x.shape
+    hd = cfg.head_dim
+    dt = cfg.dtype
+
+    hidden = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+    q = (hidden @ layer["attn"]["wq"].astype(dt)).reshape(b, s, -1, hd)
+    k = (hidden @ layer["attn"]["wk"].astype(dt)).reshape(b, s, -1, hd)
+    v = (hidden @ layer["attn"]["wv"].astype(dt)).reshape(b, s, -1, hd)
+    q, k = apply_rope(q, k, cos, sin)
+
+    row_update = lambda c, n, w: jax.lax.dynamic_update_slice(c, n, (w, 0, 0))
+    cache_k = jax.vmap(row_update)(cache_k, k, write_pos)
+    cache_v = jax.vmap(row_update)(cache_v, v, write_pos)
+
+    attn_out = attention(q, cache_k, cache_v, kv_mask, causal=False)
+    attn_out = attn_out.reshape(b, s, -1) @ layer["attn"]["wo"].astype(dt)
+    x = llama.mlp_block(layer, x + attn_out, cfg)
+    return x, cache_k, cache_v
+
+
+@partial(jax.jit, static_argnames=("cfg",),
+         donate_argnames=("cache", "kv_mask"))
+def decode_step(params: Params, token: jnp.ndarray, cache: dict,
+                pos: jnp.ndarray, write_pos: jnp.ndarray,
+                kv_mask: jnp.ndarray, keys: jnp.ndarray,
+                temperature: jnp.ndarray, top_k: jnp.ndarray,
+                top_p: jnp.ndarray, cfg: LlamaConfig) -> dict:
+    """One continuous-batching decode tick over every slot row.
+
+    token/pos/write_pos: [b] int32; cache: k/v [L, b, max_len, kv_h, hd];
+    kv_mask: [b, max_len]; keys: [b, 2] per-request rng chains;
+    temperature/top_k/top_p: [b] per-request sampling knobs. Free slots ride
+    along (static shape, one compile): their kv_mask rows are garbage and
+    their sampled tokens are discarded by the host scheduler — admission
+    rewrites the whole row.
+
+    Each row mirrors one `generate()` scan step exactly: mark write_pos
+    valid BEFORE the forward (the token attends to itself), advance the rng
+    chain with the same `split(rng) -> (chain, sub)` discipline, sample
+    with the same arithmetic. Returns {"token": [b] next tokens, "cache",
+    "kv_mask", "keys"}; rope/write positions advance by one — the caller
+    tracks them host-side.
+    """
+    b = token.shape[0]
+    kv_mask = kv_mask.at[jnp.arange(b), write_pos].set(1)
+
+    x = llama.embed(params, token[:, None], cfg)
+    cos, sin = rope_cos_sin(pos[:, None], cfg.head_dim, cfg.rope_theta,
+                            dtype=cfg.dtype)
+
+    def body(h, xs):
+        layer, ck, cv = xs
+        h, ck, cv = _layer_decode_rowwise(layer, h, ck, cv, write_pos,
+                                          kv_mask, cos, sin, cfg)
+        return h, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x,
+                                     (params["layers"], cache["k"], cache["v"]))
+    x = llama.final_norm(params, x, cfg)
+    logits = llama.lm_head(params, x, cfg)[:, -1, :]
+
+    split = jax.vmap(jax.random.split)(keys)        # [b, 2, 2]
+    nxt = sample_rowwise(logits, temperature, top_k, top_p, split[:, 1])
+    return {"token": nxt, "cache": {"k": new_k, "v": new_v},
+            "kv_mask": kv_mask, "keys": split[:, 0]}
+
+
+@partial(jax.jit, donate_argnames=("cache", "kv_mask"))
+def write_slot(cache: dict, kv_mask: jnp.ndarray, slot: jnp.ndarray,
+               row_cache: dict, row_kv_mask: jnp.ndarray
+               ) -> tuple[dict, jnp.ndarray]:
+    """Splice one prefilled request (`prefill_prompt` output, b == 1) into
+    slot row `slot` of the long-lived serving cache. `slot` is traced, so
+    admission reuses one compiled program for every slot index."""
+    cache = {
+        "k": jax.lax.dynamic_update_slice(
+            cache["k"], row_cache["k"], (0, slot, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(
+            cache["v"], row_cache["v"], (0, slot, 0, 0, 0)),
+    }
+    kv_mask = jax.lax.dynamic_update_slice(kv_mask, row_kv_mask, (slot, 0))
+    return cache, kv_mask

@@ -1,0 +1,28 @@
+"""Continuous-batching serving subsystem (docs/SERVING.md).
+
+The second workload next to training: the decode stack generalized from
+one-shot batches to a long-lived service — slot-managed static KV cache
+(slots.py), admission scheduler with continuous batching (engine.py),
+SLO telemetry (telemetry.py), and a stdlib HTTP front-end (frontend.py).
+`tools/serve.py` wraps it into a supervised process; `tools/
+serving_report.py` summarizes its telemetry offline.
+"""
+
+from llama_pipeline_parallel_tpu.serve.engine import (
+    EngineShutdown,
+    RequestHandle,
+    RequestRejected,
+    ServeConfig,
+    ServeEngine,
+    ServeLoop,
+    ServeOverloaded,
+    ServeRequest,
+)
+from llama_pipeline_parallel_tpu.serve.slots import SlotKVCache
+from llama_pipeline_parallel_tpu.serve.telemetry import SLOStats
+
+__all__ = [
+    "EngineShutdown", "RequestHandle", "RequestRejected", "ServeConfig",
+    "ServeEngine", "ServeLoop", "ServeOverloaded", "ServeRequest",
+    "SlotKVCache", "SLOStats",
+]

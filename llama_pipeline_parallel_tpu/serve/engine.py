@@ -1,0 +1,516 @@
+"""Continuous-batching inference engine (docs/SERVING.md).
+
+The admission/batch scheduler over the slot manager: requests enter a
+bounded FIFO wait queue (`submit`, thread-safe — overload raises
+`ServeOverloaded`, the backpressure signal the frontend maps to HTTP 429),
+and at every `step()` boundary the engine
+
+1. **admits** queued requests into free slots — each admission left-pads
+   the prompt to the smallest configured bucket, runs `prefill_prompt`
+   (one compile per bucket), samples the request's FIRST token with its own
+   rng chain, and splices the row into the long-lived cache
+   (`SlotKVCache.admit`) — prefill-then-join;
+2. runs ONE `decode_step` over every slot (static shape, one compile) —
+   per-row write positions, rope positions, rng chains, and sampling knobs,
+   so requests at different depths and with different `GenerationConfig`s
+   share the tick;
+3. distributes the sampled tokens to their streaming handles and frees the
+   slots of finished rows (eos or budget) immediately, so the next boundary
+   can admit again.
+
+Token parity contract: a request served here emits EXACTLY the tokens of an
+independent `generate(params, padded_prompt, cfg, gen,
+rng=PRNGKey(request.seed))` call (prompt left-padded to the same bucket) —
+the decode-layer entry points reproduce generate()'s arithmetic per row,
+and tests/test_serving.py pins it.
+
+Per-request determinism: the rng chain is derived from `request.seed` only
+— admission order, co-tenants, and slot index cannot perturb a request's
+tokens.
+
+This module is deliberately host-side and single-stepper: `step()` is
+driven either by `ServeLoop` (a background thread for in-process use), by
+tools/serve.py's main loop (so serve spans land in the RunClock's `serve`
+bucket), or manually by tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue as queue_mod
+import threading
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llama_pipeline_parallel_tpu.models.llama import decode
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+from llama_pipeline_parallel_tpu.models.llama.decode import GenerationConfig
+from llama_pipeline_parallel_tpu.serve.slots import SlotKVCache
+from llama_pipeline_parallel_tpu.serve.telemetry import SLOStats
+from llama_pipeline_parallel_tpu.utils import trace
+from llama_pipeline_parallel_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_REQUEST_IDS = itertools.count()
+
+
+class ServeOverloaded(RuntimeError):
+    """Wait queue full: the backpressure signal (HTTP 429 upstream)."""
+
+
+class EngineShutdown(RuntimeError):
+    """The engine is shut down: nothing will ever serve this request
+    (HTTP 503 upstream — the client must go to another replica)."""
+
+
+class RequestRejected(ValueError):
+    """Request can never be served by this engine's shape budget."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine shape/scheduling budget, fixed at construction (the cache is
+    allocated once from it)."""
+
+    max_slots: int = 8
+    max_len: int = 2048                # per-slot KV capacity (prompt + new)
+    prompt_buckets: tuple = (64, 128, 256, 512, 1024)
+    max_queue: int = 64                # bounded wait queue (backpressure)
+    metrics_every: int = 16            # completions per serving metrics line
+    # decode ticks per aggregated serve_decode_step span line: ticks run at
+    # token rate (orders of magnitude denser than train steps), so per-tick
+    # jsonl lines would grow spans.jsonl without bound on a long-lived
+    # replica; durations still accumulate exactly (the RunClock listener
+    # sees the aggregate), only the file granularity coarsens
+    decode_span_every: int = 32
+
+    def __post_init__(self) -> None:
+        if self.decode_span_every < 1:
+            raise ValueError("decode_span_every must be >= 1")
+        if not self.prompt_buckets:
+            raise ValueError("prompt_buckets must be non-empty")
+        if tuple(sorted(self.prompt_buckets)) != tuple(self.prompt_buckets):
+            raise ValueError(f"prompt_buckets must be ascending, got "
+                             f"{self.prompt_buckets}")
+        if min(self.prompt_buckets) < 1:
+            raise ValueError("prompt buckets must be >= 1")
+        if min(self.prompt_buckets) + 1 > self.max_len:
+            raise ValueError(
+                f"max_len {self.max_len} cannot hold even the smallest "
+                f"bucket {min(self.prompt_buckets)} plus one generated token")
+        if self.max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    input_ids: list
+    gen: GenerationConfig = dataclasses.field(default_factory=GenerationConfig)
+    seed: int = 0
+    request_id: str = dataclasses.field(
+        default_factory=lambda: f"req-{next(_REQUEST_IDS)}")
+    arrival: float = dataclasses.field(default_factory=time.time)
+
+
+class RequestHandle:
+    """The caller's end of a submitted request: a streaming token iterator
+    plus a blocking result. Thread-safe — the engine loop pushes, frontend
+    threads consume."""
+
+    _DONE = object()
+
+    def __init__(self, request: ServeRequest):
+        self.request = request
+        self.tokens_out: list[int] = []
+        self.error: Exception | None = None
+        self._q: queue_mod.Queue = queue_mod.Queue()
+        self._done = threading.Event()
+
+    # -- engine side -------------------------------------------------------
+
+    def _push(self, token: int) -> None:
+        self.tokens_out.append(token)
+        self._q.put(token)
+
+    def _finish(self, error: Exception | None = None) -> None:
+        self.error = error
+        self._done.set()
+        self._q.put(self._DONE)
+
+    # -- caller side -------------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def tokens(self, timeout: float | None = None):
+        """Yield tokens as they are generated; raises the request's error
+        (if any) after the stream ends. `timeout` bounds the wait for EACH
+        token, not the whole stream."""
+        while True:
+            item = self._q.get(timeout=timeout)
+            if item is self._DONE:
+                break
+            yield item
+        if self.error is not None:
+            raise self.error
+
+    def result(self, timeout: float | None = None) -> list[int]:
+        """All tokens, blocking until the request completes."""
+        if not self._done.wait(timeout=timeout):
+            raise TimeoutError(
+                f"request {self.request.request_id} not done in {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return list(self.tokens_out)
+
+
+@dataclasses.dataclass
+class _Running:
+    """Host-side state of one occupied slot."""
+
+    request: ServeRequest
+    handle: RequestHandle
+    token: int               # last emitted token (the next step's input)
+    pos: int                 # its rope position
+    write_pos: int           # its cache row
+    key: np.ndarray          # [2] uint32 rng chain
+    emitted: int
+    t_admit: float
+    t_first: float
+
+
+class ServeEngine:
+    def __init__(self, params: dict, cfg: LlamaConfig, serve_cfg: ServeConfig,
+                 metrics_writer=None):
+        """`params` in the CANONICAL (unstacked) layout —
+        `ckpt.load_module_checkpoint` hands them out straight from any
+        training checkpoint (the train->serve handoff)."""
+        self.params = params
+        self.cfg = cfg
+        self.serve_cfg = serve_cfg
+        self.slots = SlotKVCache(cfg, serve_cfg.max_slots, serve_cfg.max_len)
+        self.stats = SLOStats()
+        self._metrics_writer = metrics_writer
+        self._occupants: dict[int, _Running] = {}
+        self._queue: deque = deque()
+        self._closed = False
+        self._lock = threading.Lock()
+        self._work = threading.Event()   # ServeLoop parks on this when idle
+        self._sample_first = jax.jit(decode.sample_rowwise)
+        self.steps = 0
+        # pending aggregated serve_decode_step span (decode_span_every)
+        self._tick_ts = 0.0
+        self._tick_accum = 0.0
+        self._tick_count = 0
+        self._tick_active = 0
+
+    # -- submission (any thread) ------------------------------------------
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def pick_bucket(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Smallest configured bucket holding the prompt whose budget still
+        fits the slot capacity; RequestRejected when none can ever."""
+        for bucket in self.serve_cfg.prompt_buckets:
+            if (bucket >= prompt_len
+                    and bucket + max_new_tokens <= self.serve_cfg.max_len):
+                return bucket
+        raise RequestRejected(
+            f"prompt of {prompt_len} tokens + {max_new_tokens} new does not "
+            f"fit any bucket {self.serve_cfg.prompt_buckets} within "
+            f"max_len {self.serve_cfg.max_len}")
+
+    def submit(self, request: ServeRequest) -> RequestHandle:
+        """Enqueue a request; returns its streaming handle. Raises
+        `RequestRejected` (unservable shape) or `ServeOverloaded` (wait
+        queue full — shed load upstream). Both count as rejections in the
+        SLO stats — an operator watching `requests_rejected` must see a
+        storm of unservable shapes as clearly as queue overload."""
+        try:
+            if len(request.input_ids) == 0:
+                raise RequestRejected("empty prompt")
+            self.pick_bucket(len(request.input_ids),
+                             request.gen.max_new_tokens)
+        except RequestRejected:
+            self.stats.record_rejected()
+            raise
+        handle = RequestHandle(request)
+        with self._lock:
+            if self._closed:  # a late submit must fail loudly, never hang
+                raise EngineShutdown("serve engine shut down")
+            if len(self._queue) >= self.serve_cfg.max_queue:
+                self.stats.record_rejected()
+                raise ServeOverloaded(
+                    f"wait queue full ({self.serve_cfg.max_queue})")
+            self._queue.append((request, handle))
+        self._work.set()
+        return handle
+
+    # -- scheduling (the loop thread) -------------------------------------
+
+    def step(self) -> bool:
+        """One step boundary: admit, then one decode tick over all slots.
+        Returns False when there was nothing to do (caller may sleep)."""
+        self._admit_pending()
+        if not self._occupants:
+            self._flush_decode_span()  # idle boundary: publish the tail
+            self._work.clear()
+            # submit() may have raced the clear — don't sleep on a full queue
+            if self.queue_depth():
+                self._work.set()
+            return False
+        self._decode_tick()
+        self.steps += 1
+        return True
+
+    def _admit_pending(self) -> None:
+        while True:
+            with self._lock:
+                if not self._queue:
+                    return
+                slot = self.slots.acquire(self._queue[0][0].request_id)
+                if slot is None:
+                    return
+                request, handle = self._queue.popleft()
+            try:
+                self._admit(request, handle, slot)
+            except Exception as e:  # a poisoned request must not kill serving
+                logger.exception("admission of %s failed", request.request_id)
+                self.stats.record_failed()  # visible on the metrics line
+                self.slots.release(slot)
+                handle._finish(e)
+
+    def _admit(self, request: ServeRequest, handle: RequestHandle,
+               slot: int) -> None:
+        gen = request.gen
+        t_admit = time.time()
+        trace.recorder().emit("serve_queue_wait", ts=request.arrival,
+                              dur=t_admit - request.arrival,
+                              request=request.request_id)
+        bucket = self.pick_bucket(len(request.input_ids), gen.max_new_tokens)
+        pad = bucket - len(request.input_ids)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, pad:] = np.asarray(request.input_ids, np.int32)
+        mask = np.zeros((1, bucket), np.int32)
+        mask[0, pad:] = 1
+
+        with trace.span("serve_prefill", request=request.request_id,
+                        bucket=bucket, slot=slot):
+            out = decode.prefill_prompt(self.params, jnp.asarray(ids),
+                                        jnp.asarray(mask), self.cfg,
+                                        self.serve_cfg.max_len)
+            chain, first_key = jax.random.split(jax.random.PRNGKey(request.seed))
+            first = self._sample_first(
+                out["logits"],
+                jnp.asarray([gen.temperature], jnp.float32),
+                jnp.asarray([gen.top_k], jnp.int32),
+                jnp.asarray([gen.top_p], jnp.float32),
+                first_key[None])
+            self.slots.admit(slot, out)
+            token = int(first[0])
+            next_pos = int(out["next_pos"][0])
+
+        t_first = time.time()
+        trace.recorder().emit("serve_ttft", ts=request.arrival,
+                              dur=t_first - request.arrival,
+                              request=request.request_id)
+        running = _Running(request=request, handle=handle, token=token,
+                           pos=next_pos, write_pos=bucket,
+                           key=np.asarray(chain), emitted=1,
+                           t_admit=t_admit, t_first=t_first)
+        self._occupants[slot] = running
+        handle._push(token)
+        if (gen.eos_token_id is not None and token == gen.eos_token_id) \
+                or gen.max_new_tokens == 1:
+            self._finish(slot, running)  # freed before any decode tick
+
+    def _decode_tick(self) -> None:
+        scfg = self.serve_cfg
+        S = scfg.max_slots
+        token = np.zeros(S, np.int32)
+        pos = np.zeros(S, np.int32)
+        write_pos = np.zeros(S, np.int32)
+        keys = np.zeros((S, 2), np.uint32)
+        temps = np.zeros(S, np.float32)
+        top_ks = np.zeros(S, np.int32)
+        top_ps = np.ones(S, np.float32)
+        for slot, r in self._occupants.items():
+            token[slot] = r.token
+            pos[slot] = r.pos
+            write_pos[slot] = r.write_pos
+            keys[slot] = r.key
+            temps[slot] = r.request.gen.temperature
+            top_ks[slot] = r.request.gen.top_k
+            top_ps[slot] = r.request.gen.top_p
+
+        n_active = len(self._occupants)
+        t_wall = time.time()
+        t0 = time.perf_counter()
+        out = decode.decode_step(
+            self.params, jnp.asarray(token), self.slots.cache,
+            jnp.asarray(pos), jnp.asarray(write_pos), self.slots.kv_mask,
+            jnp.asarray(keys), jnp.asarray(temps), jnp.asarray(top_ks),
+            jnp.asarray(top_ps), self.cfg)
+        self.slots.update_from_step(out)
+        next_token = np.asarray(out["token"])       # blocks: real tick time
+        new_keys = np.asarray(out["keys"])
+        self._note_decode_tick(t_wall, time.perf_counter() - t0, n_active)
+
+        for slot in list(self._occupants):
+            r = self._occupants[slot]
+            tok = int(next_token[slot])
+            r.token = tok
+            r.pos += 1
+            r.write_pos += 1
+            r.key = new_keys[slot]
+            r.emitted += 1
+            r.handle._push(tok)
+            gen = r.request.gen
+            if (gen.eos_token_id is not None and tok == gen.eos_token_id) \
+                    or r.emitted >= gen.max_new_tokens:
+                self._finish(slot, r)
+
+    def _note_decode_tick(self, ts: float, dur: float, active: int) -> None:
+        """Fold one decode tick into the pending aggregated
+        `serve_decode_step` span; flush every `decode_span_every` ticks
+        (and at idle boundaries / shutdown). The emitted span's `dur` is
+        the exact sum of its `ticks` tick durations, so RunClock's `serve`
+        bucket and the goodput fraction lose nothing to the aggregation —
+        only the spans.jsonl line rate drops from token rate."""
+        if self._tick_count == 0:
+            self._tick_ts = ts
+        self._tick_accum += dur
+        self._tick_count += 1
+        self._tick_active = active
+        if self._tick_count >= self.serve_cfg.decode_span_every:
+            self._flush_decode_span()
+
+    def _flush_decode_span(self) -> None:
+        if self._tick_count == 0:
+            return
+        trace.recorder().emit("serve_decode_step", ts=self._tick_ts,
+                              dur=self._tick_accum, ticks=self._tick_count,
+                              active=self._tick_active)
+        self._tick_ts, self._tick_accum = 0.0, 0.0
+        self._tick_count, self._tick_active = 0, 0
+
+    def _finish(self, slot: int, r: _Running,
+                error: Exception | None = None) -> None:
+        t_done = time.time()
+        ttft = r.t_first - r.request.arrival
+        tpot = ((t_done - r.t_first) / (r.emitted - 1)
+                if r.emitted > 1 else None)
+        queue_wait = r.t_admit - r.request.arrival
+        trace.recorder().emit(
+            "serve_request", ts=r.request.arrival,
+            dur=t_done - r.request.arrival, request=r.request.request_id,
+            tokens=r.emitted, ttft=ttft, tpot=tpot, queue_wait=queue_wait,
+            slot=slot)
+        self.stats.record(ttft=ttft, tpot=tpot, queue_wait=queue_wait,
+                          tokens=r.emitted)
+        self._occupants.pop(slot, None)
+        self.slots.release(slot)
+        r.handle._finish(error)
+        if (self._metrics_writer is not None
+                and self.stats.completed % self.serve_cfg.metrics_every == 0):
+            self._metrics_writer.log(self.stats.completed,
+                                     self.metrics_snapshot())
+
+    # -- introspection / teardown -----------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        """The serving metrics line: SLO percentiles + live occupancy."""
+        snap = {"serving": 1, **self.stats.snapshot()}
+        snap["active_slots"] = self.slots.active_count
+        snap["queue_depth"] = self.queue_depth()
+        snap["slot_allocations"] = self.slots.allocations
+        snap["decode_steps"] = self.steps
+        return snap
+
+    def drain(self, timeout_s: float = 60.0) -> None:
+        """Step until queue and slots are empty (tests / synchronous use)."""
+        deadline = time.monotonic() + timeout_s
+        while self._occupants or self.queue_depth():
+            if time.monotonic() > deadline:
+                raise TimeoutError("engine did not drain in time")
+            self.step()
+
+    def shutdown(self) -> None:
+        """Fail every queued and in-flight request (process exit path);
+        later submits raise EngineShutdown instead of queueing into a dead
+        engine."""
+        self._flush_decode_span()
+        err = EngineShutdown("serve engine shut down")
+        with self._lock:
+            self._closed = True
+            pending = list(self._queue)
+            self._queue.clear()
+        for _, handle in pending:
+            handle._finish(err)
+        for slot in list(self._occupants):
+            r = self._occupants.pop(slot)
+            self.slots.release(slot)
+            r.handle._finish(err)
+
+
+class ServeLoop:
+    """Background driver for in-process use (tests, notebooks): a thread
+    calling `engine.step()`, parking on the engine's work event when idle.
+    tools/serve.py does NOT use this — its loop runs on the main thread so
+    serve spans feed the RunClock buckets."""
+
+    def __init__(self, engine: ServeEngine, idle_wait_s: float = 0.05):
+        self.engine = engine
+        self._idle_wait = idle_wait_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-loop")
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if not self.engine.step():
+                    self.engine._work.wait(self._idle_wait)
+            except Exception:
+                # decode_step/write_slot DONATE the long-lived cache, so a
+                # failed step leaves the slot state poisoned — retrying
+                # would raise forever while blocked clients hang. Fail every
+                # handle (and future submits) instead, like the process
+                # loop's exit path does.
+                logger.exception("serve loop step failed; shutting the "
+                                 "engine down")
+                self.engine.shutdown()
+                return
+
+    def start(self) -> "ServeLoop":
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        self.engine._work.set()
+        self._thread.join(timeout=timeout_s)
+        if self._thread.is_alive():
+            # a step (e.g. a long TPU compile) is still running: shutting
+            # the engine down now would free slots and finish handles
+            # CONCURRENTLY with that step's own bookkeeping — leave the
+            # state alone and let the daemon thread die with the process
+            logger.warning("serve loop still inside a step after %.0fs; "
+                           "skipping engine shutdown", timeout_s)
+            return
+        self.engine.shutdown()
+
+    def __enter__(self) -> "ServeLoop":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,140 @@
+"""Request front-end: a stdlib JSON-lines HTTP endpoint over the engine.
+
+Deliberately dependency-free (http.server) — the serving story must run on
+a bare TPU VM image. The in-process path (`ServeEngine.submit` +
+`RequestHandle`) is the primary API and what tests use; this module only
+maps it onto sockets:
+
+  POST /v1/generate   {"input_ids": [...], "max_new_tokens": 16,
+                       "temperature": 0.8, "top_k": 40, "top_p": 0.95,
+                       "eos_token_id": 2, "seed": 7, "stream": true}
+    stream=false -> one JSON body {"request_id", "tokens"}.
+    stream=true  -> one JSON line per token {"token": id} as it is
+                    generated, then a final {"done": true, "request_id",
+                    "tokens"} line (connection close delimits the stream —
+                    HTTP/1.0 framing, curl/urllib read it naturally).
+  GET /healthz        engine SLO/occupancy snapshot (the same dict the
+                      serving metrics line carries).
+
+Backpressure maps to status codes: ServeOverloaded -> 429 (wait queue
+full), RequestRejected -> 400 (shape can never be served). The engine loop
+runs elsewhere (tools/serve.py main thread or ServeLoop); handler threads
+only block on their request's handle.
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from llama_pipeline_parallel_tpu.models.llama.decode import GenerationConfig
+from llama_pipeline_parallel_tpu.serve.engine import (
+    EngineShutdown,
+    RequestRejected,
+    ServeEngine,
+    ServeOverloaded,
+    ServeRequest,
+)
+from llama_pipeline_parallel_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+GEN_KEYS = ("max_new_tokens", "temperature", "top_k", "top_p",
+            "eos_token_id", "pad_token_id")
+
+
+def request_from_json(body: dict) -> ServeRequest:
+    """Decode one API request body; ValueError on malformed input."""
+    if not isinstance(body, dict):
+        raise ValueError("request body must be a JSON object")
+    ids = body.get("input_ids")
+    if (not isinstance(ids, list) or not ids
+            or not all(isinstance(i, int) for i in ids)):
+        raise ValueError("input_ids must be a non-empty list of ints")
+    gen_kw = {k: body[k] for k in GEN_KEYS if body.get(k) is not None}
+    return ServeRequest(input_ids=ids, gen=GenerationConfig(**gen_kw),
+                        seed=int(body.get("seed", 0)))
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # HTTP/1.0: the streaming response is delimited by connection close,
+    # no chunked-encoding framing to hand-roll
+    protocol_version = "HTTP/1.0"
+    server_version = "lpt-serve/1"
+
+    @property
+    def engine(self) -> ServeEngine:
+        return self.server.engine  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):  # stdlib default spams stderr
+        logger.debug("http %s", fmt % args)
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        body = (json.dumps(payload) + "\n").encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            return self._send_json(200, self.engine.metrics_snapshot())
+        return self._send_json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        if self.path != "/v1/generate":
+            return self._send_json(404, {"error": f"no route {self.path}"})
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            request = request_from_json(body)
+        except (ValueError, TypeError) as e:
+            return self._send_json(400, {"error": str(e)})
+        try:
+            handle = self.engine.submit(request)
+        except ServeOverloaded as e:
+            return self._send_json(429, {"error": str(e)})
+        except RequestRejected as e:
+            return self._send_json(400, {"error": str(e)})
+        except EngineShutdown as e:  # process exiting: go to another replica
+            return self._send_json(503, {"error": str(e)})
+
+        if not body.get("stream"):
+            try:
+                tokens = handle.result()
+            except Exception as e:
+                return self._send_json(500, {"error": repr(e)})
+            return self._send_json(200, {"request_id": request.request_id,
+                                         "tokens": tokens})
+
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonlines")
+        self.end_headers()
+        try:
+            for token in handle.tokens():
+                self.wfile.write((json.dumps({"token": token}) + "\n").encode())
+                self.wfile.flush()
+            tail = {"done": True, "request_id": request.request_id,
+                    "tokens": handle.tokens_out}
+        except Exception as e:
+            tail = {"done": True, "request_id": request.request_id,
+                    "error": repr(e)}
+        try:
+            self.wfile.write((json.dumps(tail) + "\n").encode())
+        except OSError:
+            # client hung up mid-stream; the request itself keeps running
+            # to completion (no cancellation protocol yet) — just stop
+            # writing, don't let socketserver traceback every disconnect
+            logger.debug("client disconnected during stream of %s",
+                         request.request_id)
+
+
+def make_server(engine: ServeEngine, host: str = "127.0.0.1",
+                port: int = 0) -> ThreadingHTTPServer:
+    """Bound (not yet serving) HTTP server; port 0 picks an ephemeral port
+    — read the bound one off `server.server_address`."""
+    server = ThreadingHTTPServer((host, port), _Handler)
+    server.engine = engine  # type: ignore[attr-defined]
+    server.daemon_threads = True
+    return server

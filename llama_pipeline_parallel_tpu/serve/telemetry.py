@@ -1,0 +1,103 @@
+"""Serving SLO accounting: TTFT / TPOT / queue-wait percentiles.
+
+The serving counterpart of utils/trace's goodput layer. Per-request records
+land in TWO streams the repo already owns:
+
+- **spans.jsonl** (utils/trace): the engine emits retroactive spans
+  `serve_queue_wait` (arrival -> admission), `serve_ttft` (arrival -> first
+  token), and `serve_request` (arrival -> completion, with `ttft`/`tpot`/
+  `queue_wait`/`tokens` attrs) per request, plus live `serve_prefill` /
+  `serve_decode_step` spans that feed the RunClock's `serve` bucket.
+- **metrics.jsonl** (utils/metrics.MetricsWriter): every `metrics_every`
+  completions the engine logs one serving line with the rolling percentiles
+  this module computes.
+
+Definitions (docs/SERVING.md "SLO metrics"):
+- `queue_wait` — request arrival to slot admission (scheduler latency).
+- `TTFT` — time to first token: arrival to the prefill-sampled token.
+  Includes queue_wait: it is the user-visible first-byte latency.
+- `TPOT` — time per output token over the DECODE tail: (completion -
+  first token) / (tokens - 1). Undefined for single-token requests.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+
+def percentile(values, q: float) -> float | None:
+    """Nearest-rank percentile of an unsorted sequence (None when empty).
+    Plain python on purpose: offline tools import this without jax/numpy."""
+    if not values:
+        return None
+    ordered = sorted(values)
+    rank = max(0, min(len(ordered) - 1,
+                      int(round(q / 100.0 * (len(ordered) - 1)))))
+    return ordered[rank]
+
+
+def percentiles_ms(values, prefix: str, qs=(50, 95, 99)) -> dict:
+    """{prefix_p50_ms: ..., ...} for the given quantiles; empty input ->
+    empty dict (a metrics line must not carry fabricated zeros)."""
+    out = {}
+    for q in qs:
+        p = percentile(values, q)
+        if p is not None:
+            out[f"{prefix}_p{q}_ms"] = round(1000.0 * p, 3)
+    return out
+
+
+class SLOStats:
+    """Rolling serving-SLO accumulator (thread-safe: the engine loop records
+    while frontend threads snapshot for /healthz).
+
+    Percentiles are over a bounded window of the most recent `window`
+    requests — a long-lived serve process must report CURRENT tail latency,
+    not its lifetime average — while the counters are cumulative.
+    """
+
+    def __init__(self, window: int = 1024):
+        self._lock = threading.Lock()
+        self.ttft = collections.deque(maxlen=window)
+        self.tpot = collections.deque(maxlen=window)
+        self.queue_wait = collections.deque(maxlen=window)
+        self.completed = 0
+        self.rejected = 0
+        self.failed = 0
+        self.tokens_generated = 0
+
+    def record(self, ttft: float, tpot: float | None, queue_wait: float,
+               tokens: int) -> None:
+        with self._lock:
+            self.completed += 1
+            self.tokens_generated += tokens
+            self.ttft.append(ttft)
+            self.queue_wait.append(queue_wait)
+            if tpot is not None:
+                self.tpot.append(tpot)
+
+    def record_rejected(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_failed(self) -> None:
+        """Accepted but errored (admission/engine failure, not a client
+        mistake): these must move a counter too, or an error storm looks
+        like a healthy idle replica."""
+        with self._lock:
+            self.failed += 1
+
+    def snapshot(self) -> dict:
+        """One flat dict: cumulative counters + windowed percentiles, ms."""
+        with self._lock:
+            out = {
+                "requests_completed": self.completed,
+                "requests_rejected": self.rejected,
+                "requests_failed": self.failed,
+                "tokens_generated": self.tokens_generated,
+            }
+            out.update(percentiles_ms(list(self.ttft), "ttft"))
+            out.update(percentiles_ms(list(self.tpot), "tpot"))
+            out.update(percentiles_ms(list(self.queue_wait), "queue_wait"))
+            return out

@@ -54,10 +54,18 @@ SPAN_BUCKETS = {
     "eval": "eval",
     "ckpt_save": "ckpt",
     "ckpt_restore": "ckpt",
+    # the serving workload's useful-work spans (serve/engine.py): goodput
+    # for a serve process is serve seconds / elapsed, same contract as train
+    "serve_prefill": "serve",
+    "serve_decode_step": "serve",
 }
 
-BUCKETS = ("init", "compile", "train", "data_stall", "ckpt", "eval",
+BUCKETS = ("init", "compile", "train", "serve", "data_stall", "ckpt", "eval",
            "untracked")
+
+# buckets that count as goodput: useful work of EITHER workload (a process
+# runs one of them, so the sum never double-counts)
+GOODPUT_BUCKETS = ("train", "serve")
 
 
 class SpanRecorder:
@@ -243,8 +251,11 @@ class RunClock:
         """Cumulative run seconds, prior incarnations included."""
         return self._prior_elapsed + self._pre + (time.perf_counter() - self._t0)
 
+    def _good_seconds(self) -> float:
+        return sum(self.buckets.get(b, 0.0) for b in GOODPUT_BUCKETS)
+
     def goodput(self) -> float:
-        return self.buckets.get("train", 0.0) / max(self.elapsed(), 1e-9)
+        return self._good_seconds() / max(self.elapsed(), 1e-9)
 
     def snapshot(self) -> dict:
         e = self.elapsed()
@@ -254,7 +265,7 @@ class RunClock:
         # goodput against the SAME elapsed sample as the buckets — a second
         # clock read would make the snapshot internally inconsistent
         return {"elapsed": e,
-                "goodput": self.buckets.get("train", 0.0) / max(e, 1e-9),
+                "goodput": self._good_seconds() / max(e, 1e-9),
                 "buckets": out}
 
 

@@ -176,3 +176,129 @@ def test_sampling_seeded_and_in_vocab(setup):
     t1 = np.asarray(r1["tokens"])
     np.testing.assert_array_equal(t1, np.asarray(r2["tokens"]))
     assert ((t1 >= 0) & (t1 < cfg.vocab_size)).all()
+
+
+def test_top_p_sampling_seeded_and_in_vocab(setup):
+    """Nucleus sampling: deterministic under a fixed key, in-vocab, and a
+    top_p below the top token's own probability degrades to greedy (the
+    filter always keeps the argmax)."""
+    cfg, params = setup
+    ids = np.random.RandomState(6).randint(3, cfg.vocab_size, (2, 5)).astype(np.int32)
+    mask = np.ones_like(ids)
+    gen = GenerationConfig(max_new_tokens=4, temperature=0.9, top_p=0.8)
+
+    r1 = generate(params, jnp.asarray(ids), jnp.asarray(mask), cfg, gen,
+                  rng=jax.random.PRNGKey(11))
+    r2 = generate(params, jnp.asarray(ids), jnp.asarray(mask), cfg, gen,
+                  rng=jax.random.PRNGKey(11))
+    t1 = np.asarray(r1["tokens"])
+    np.testing.assert_array_equal(t1, np.asarray(r2["tokens"]))
+    assert ((t1 >= 0) & (t1 < cfg.vocab_size)).all()
+
+    # a vanishingly small nucleus leaves only the argmax: greedy, any key
+    tiny = GenerationConfig(max_new_tokens=4, temperature=0.9, top_p=1e-9)
+    nucleus = generate(params, jnp.asarray(ids), jnp.asarray(mask), cfg, tiny,
+                       rng=jax.random.PRNGKey(3))
+    greedy = generate(params, jnp.asarray(ids), jnp.asarray(mask), cfg,
+                      GenerationConfig(max_new_tokens=4))
+    np.testing.assert_array_equal(np.asarray(nucleus["tokens"]),
+                                  np.asarray(greedy["tokens"]))
+
+    with pytest.raises(ValueError):
+        GenerationConfig(top_p=0.0)
+    with pytest.raises(ValueError):
+        GenerationConfig(top_p=1.5)
+
+
+def test_max_new_tokens_one_empty_scan(setup):
+    """max_new_tokens=1: the decode scan is empty; the single token is the
+    prefill-sampled one (argmax of the cache-free forward's last logits)."""
+    cfg, params = setup
+    rng = np.random.RandomState(4)
+    ids = rng.randint(3, cfg.vocab_size, (2, 6)).astype(np.int32)
+    mask = np.ones_like(ids)
+
+    got = generate(params, jnp.asarray(ids), jnp.asarray(mask), cfg,
+                   GenerationConfig(max_new_tokens=1))
+    want = greedy_no_cache(params, cfg, ids, mask, 1)
+    assert np.asarray(got["tokens"]).shape == (2, 1)
+    np.testing.assert_array_equal(np.asarray(got["tokens"]), want)
+    assert not np.asarray(got["done"]).any()  # no eos configured
+
+
+def test_eos_none_runs_full_budget(setup):
+    """eos_token_id=None: no row ever finishes early, done stays False, and
+    every budgeted token is a real sample (no pad substitution)."""
+    cfg, params = setup
+    ids = np.random.RandomState(8).randint(3, cfg.vocab_size, (2, 4)).astype(np.int32)
+    mask = np.ones_like(ids)
+    got = generate(params, jnp.asarray(ids), jnp.asarray(mask), cfg,
+                   GenerationConfig(max_new_tokens=6, eos_token_id=None))
+    toks = np.asarray(got["tokens"])
+    assert toks.shape == (2, 6)
+    assert not np.asarray(got["done"]).any()
+    assert ((toks >= 0) & (toks < cfg.vocab_size)).all()
+
+
+def test_all_pad_row_stays_finite(setup):
+    """A fully-padded row (mask all zero) must not poison the batch: its
+    own tokens are garbage-but-valid ids, and the REAL row generates
+    exactly what it generates alone."""
+    cfg, params = setup
+    rng = np.random.RandomState(9)
+    real = rng.randint(3, cfg.vocab_size, (1, 5)).astype(np.int32)
+    ids = np.concatenate([np.zeros((1, 5), np.int32), real], axis=0)
+    mask = np.asarray([[0] * 5, [1] * 5], np.int32)
+    gen = GenerationConfig(max_new_tokens=4)
+
+    got = np.asarray(generate(params, jnp.asarray(ids), jnp.asarray(mask),
+                              cfg, gen)["tokens"])
+    assert ((got >= 0) & (got < cfg.vocab_size)).all()
+    alone = np.asarray(generate(params, jnp.asarray(real),
+                                jnp.asarray(np.ones_like(real)), cfg,
+                                gen)["tokens"])
+    np.testing.assert_array_equal(got[1:2], alone)
+
+
+def test_train_checkpoint_to_serve_handoff(setup, tmp_path):
+    """Train->serve handoff: a TRAINING checkpoint (stacked pp=2 layout)
+    loads through load_module_checkpoint (unstack_stages + manifest) and
+    generates valid tokens — no conversion step between the workloads."""
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import (
+        CheckpointManager,
+        load_module_checkpoint,
+    )
+    from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+    from llama_pipeline_parallel_tpu.parallel.pipeline import stack_stages
+
+    cfg, params = setup
+    manifest = StageManifest.for_config(cfg, 2)
+    CheckpointManager(str(tmp_path)).save(
+        3, stack_stages(params, manifest), manifest, cfg)
+
+    loaded, loaded_cfg, _, step = load_module_checkpoint(str(tmp_path))
+    assert step == 3 and loaded_cfg.vocab_size == cfg.vocab_size
+    ids = np.random.RandomState(10).randint(3, cfg.vocab_size, (2, 5)).astype(np.int32)
+    mask = np.ones_like(ids)
+    gen = GenerationConfig(max_new_tokens=4)
+    got = np.asarray(generate(loaded, jnp.asarray(ids), jnp.asarray(mask),
+                              loaded_cfg, gen)["tokens"])
+    assert ((got >= 0) & (got < cfg.vocab_size)).all()
+    # the checkpoint round trip is the identity: tokens match the source
+    want = np.asarray(generate(params, jnp.asarray(ids), jnp.asarray(mask),
+                               cfg, gen)["tokens"])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_generate_tool_bucketing():
+    """tools/generate.py pads prompts to a BUCKET length so distinct prompt
+    lengths reuse one compiled shape."""
+    from generate import DEFAULT_BUCKETS, bucket_length  # tools/ on sys.path
+
+    assert bucket_length(1) == DEFAULT_BUCKETS[0]
+    assert bucket_length(16) == 16
+    assert bucket_length(17) == 32
+    assert bucket_length(1000) == 1024
+    # past the largest bucket: fall back to the exact length
+    assert bucket_length(5000) == 5000
+    assert bucket_length(9, buckets=(4, 12)) == 12

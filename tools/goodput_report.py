@@ -246,7 +246,9 @@ def build_report(output_dir: str, top: int = 5) -> dict:
         "output_dir": output_dir,
         "wall_seconds": wall,
         "buckets": buckets,
-        "goodput": buckets.get("train", 0.0) / max(wall, 1e-9),
+        # either workload's useful-work bucket (a process runs one of them)
+        "goodput": (buckets.get("train", 0.0) + buckets.get("serve", 0.0))
+        / max(wall, 1e-9),
         "health_status": health_status,
         "cumulative_goodput": _num(health.get("goodput")),
         "last_step": health.get("last_step"),

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Continuous-batching inference service over a native checkpoint.
+
+The serving counterpart of train.py (docs/SERVING.md): loads a checkpoint
+(the train->serve handoff — any training checkpoint's canonical layout
+loads straight into the decode stack via `load_module_checkpoint`), builds
+a `serve.ServeEngine`, exposes the JSON HTTP endpoint, and emits the SAME
+run telemetry as a trainer — spans.jsonl (TTFT/TPOT/queue-wait per
+request), metrics.jsonl (serving SLO percentile lines), and the
+health.json heartbeat — so `tools/supervisor.py` supervises a serving
+replica with zero changes and `tools/goodput_report.py` /
+`tools/serving_report.py` read its run directory like any other.
+
+    python tools/serve.py --checkpoint_dir /ckpts/run1 \
+        --output_dir /runs/serve1 --port 8000 --max_slots 8 --max_len 2048
+
+Multi-replica serving is N supervisors each watching one of these
+processes from a shared checkpoint:
+
+    python tools/supervisor.py --output-dir /runs/serve1 -- \
+        python tools/serve.py --checkpoint_dir /ckpts/run1 \
+            --output_dir /runs/serve1 --port 8000
+
+The engine loop runs on the MAIN thread (serve_prefill/serve_decode_step
+spans feed the RunClock's `serve` bucket — goodput for a serve process is
+the fraction of wall-clock spent producing tokens); HTTP handler threads
+only block on request handles. SIGTERM/SIGINT stop ADMISSIONS, drain
+in-flight and queued requests for up to --drain_s (size it inside the
+supervisor's --grace-s), then exit 0 — the preemption contract: a routine
+stop must not 500 the requests already decoding.
+
+`serve.json` in the output dir records the bound port + pid atomically, so
+clients (and the multi-replica chaos test) can find a restarted replica.
+LPT_SERVE_STEP_DELAY_S stretches every decode step (chaos hook: gives the
+kill-mid-decode test a deterministic window; never set it in production).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def write_serve_json(output_dir: str, payload: dict) -> None:
+    """Atomic `serve.json` rewrite: a polling client never reads a torn
+    file. Reuses the checkpoint layer's crash-safe writer (tmp + fsync +
+    os.replace under the storage retry policy) instead of a third
+    hand-rolled copy."""
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import _write_file_atomic
+
+    _write_file_atomic(os.path.join(output_dir, "serve.json"),
+                       json.dumps(payload, indent=2))
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. 'cpu'); default: the "
+                        "image's platform (TPU when available)")
+    p.add_argument("--checkpoint_dir", required=True)
+    p.add_argument("--step", type=int, default=None)
+    p.add_argument("--output_dir", required=True,
+                   help="telemetry home: spans/metrics/health/serve.json")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 picks an ephemeral port (recorded in serve.json)")
+    p.add_argument("--max_slots", type=int, default=8)
+    p.add_argument("--max_len", type=int, default=2048,
+                   help="per-slot KV capacity (prompt bucket + new tokens)")
+    p.add_argument("--buckets", default="64,128,256,512,1024",
+                   help="ascending prompt bucket lengths (one prefill "
+                        "compile each)")
+    p.add_argument("--max_queue", type=int, default=64)
+    p.add_argument("--metrics_every", type=int, default=16,
+                   help="completed requests per serving metrics line")
+    p.add_argument("--idle_poll_s", type=float, default=0.02)
+    p.add_argument("--drain_s", type=float, default=15.0,
+                   help="after SIGTERM/SIGINT: seconds to finish in-flight "
+                        "and queued requests before failing the remainder "
+                        "(keep below the supervisor's --grace-s)")
+    args = p.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        # env JAX_PLATFORMS is not enough on images whose sitecustomize
+        # force-registers an accelerator platform; re-pin via config.
+        jax.config.update("jax_platforms", args.platform)
+
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import (
+        load_module_checkpoint,
+    )
+    from llama_pipeline_parallel_tpu.serve import (
+        ServeConfig,
+        ServeEngine,
+    )
+    from llama_pipeline_parallel_tpu.serve.frontend import make_server
+    from llama_pipeline_parallel_tpu.utils import trace
+    from llama_pipeline_parallel_tpu.utils.metrics import MetricsWriter
+
+    t_start = time.time()
+    os.makedirs(args.output_dir, exist_ok=True)
+    trace.configure(args.output_dir)
+    clock = trace.RunClock(prior=trace.load_health(args.output_dir))
+    trace.recorder().add_listener(clock.on_span)
+
+    params, cfg, manifest, step = load_module_checkpoint(
+        args.checkpoint_dir, args.step)
+    serve_cfg = ServeConfig(
+        max_slots=args.max_slots, max_len=args.max_len,
+        prompt_buckets=tuple(int(b) for b in args.buckets.split(",")),
+        max_queue=args.max_queue, metrics_every=args.metrics_every)
+    writer = MetricsWriter(args.output_dir)
+    engine = ServeEngine(params, cfg, serve_cfg, metrics_writer=writer)
+
+    server = make_server(engine, args.host, args.port)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="serve-http").start()
+    write_serve_json(args.output_dir, {
+        "pid": os.getpid(), "host": args.host, "port": port,
+        "checkpoint_dir": args.checkpoint_dir, "checkpoint_step": step,
+        "started": t_start})
+
+    # init window accounted like the trainer's: everything before the loop
+    trace.recorder().emit("init", ts=t_start, dur=time.time() - t_start)
+    hb = trace.Heartbeat(
+        args.output_dir, clock,
+        static={"role": "serve", "port": port,
+                "checkpoint_step": step,
+                "serve_config": {"max_slots": serve_cfg.max_slots,
+                                 "max_len": serve_cfg.max_len,
+                                 "prompt_buckets": list(serve_cfg.prompt_buckets)}})
+
+    stop = threading.Event()
+
+    def _stop(signum, _frame):
+        print(f"[serve] signal {signum}: draining to clean exit", flush=True)
+        stop.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _stop)
+
+    step_delay = float(os.environ.get("LPT_SERVE_STEP_DELAY_S", "0") or 0)
+    print(f"[serve] ready on {args.host}:{port} — checkpoint step {step}, "
+          f"{serve_cfg.max_slots} slots x {serve_cfg.max_len} kv, buckets "
+          f"{serve_cfg.prompt_buckets}", flush=True)
+    try:
+        while not stop.is_set():
+            did_work = engine.step()
+            if did_work:
+                hb.beat(engine.steps)
+                if step_delay:
+                    time.sleep(step_delay)
+            else:
+                engine._work.wait(args.idle_poll_s)
+        # graceful drain: no new connections, finish what's in flight —
+        # the documented stop contract; whatever outlives the window is
+        # failed by engine.shutdown() below
+        server.shutdown()
+        deadline = time.monotonic() + args.drain_s
+        while ((engine.slots.active_count or engine.queue_depth())
+               and time.monotonic() < deadline):
+            if engine.step():
+                hb.beat(engine.steps)
+            else:  # unreachable in practice; never busy-spin the drain
+                time.sleep(0.01)
+        if engine.slots.active_count or engine.queue_depth():
+            print(f"[serve] drain window ({args.drain_s:.0f}s) expired with "
+                  f"{engine.slots.active_count} active / "
+                  f"{engine.queue_depth()} queued; failing them", flush=True)
+    finally:
+        server.shutdown()
+        engine.shutdown()
+        if engine.stats.completed:
+            writer.log(engine.stats.completed, engine.metrics_snapshot())
+        writer.close()
+        hb.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

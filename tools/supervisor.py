@@ -13,6 +13,12 @@ Usage:
   python tools/supervisor.py --output-dir /runs/exp1 [options] -- \\
       python train.py --config conf/llama_7b_pp4.yaml output_dir=/runs/exp1
 
+The watchdog is workload-agnostic: a serving replica (tools/serve.py)
+heartbeats the same health.json (with a `role: serve` label that lands in
+the ledger), so multi-replica serving is N supervisors each watching one
+serve process from a shared checkpoint — docs/SERVING.md "Supervised
+replicas".
+
 Behavior:
 - exit 0 from the child ends supervision (clean completion; the trainer's
   own preemption save counts — it exits 0).
@@ -338,6 +344,11 @@ class Supervisor:
             # written by the Heartbeat) — the ledger's authoritative label
             "trainer_topology": health.get("topology") if fresh else None,
         }
+        # serve processes (tools/serve.py) heartbeat a `role` so the ledger
+        # and goodput report can tell a serving incarnation from a training
+        # one; absent for trainers, so their rows are unchanged
+        if fresh and health.get("role"):
+            rec["role"] = health.get("role")
         if layout is not None:
             rec.update(layout)
         self._log_incarnation(rec)
