@@ -201,7 +201,8 @@ def main() -> None:
 
     mesh = make_mesh(MeshConfig())  # single chip
     manifest = StageManifest.for_config(cfg, 1)
-    stacked = pl.stack_stages(llama.init_params(jax.random.PRNGKey(0), cfg), manifest)
+    canonical = llama.init_params(jax.random.PRNGKey(0), cfg)
+    stacked = pl.stack_stages(canonical, manifest)
     tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-4, total_steps=1000,
                                                warmup_steps=10))
 
@@ -396,6 +397,67 @@ def main() -> None:
             results[f"extra:seq{long_seq}-flash,bs=8"] = {
                 "dt": dt, "tokens_per_step": 8 * long_seq, "headline": False,
                 "detail": {"seq": long_seq}}
+
+        # Schedule ladder (BENCH_SCHEDULES=0 skips): flat vs interleaved vs
+        # zb1 loss+grad step on a real pp ring over the chips this process
+        # can see, each row carrying its analytic bubble_fraction NEXT to
+        # the measured step time — so one live run lands a model-vs-
+        # measured schedule trajectory point in one shot (the repo still
+        # has no live perf number: every bench round recorded the TPU
+        # unreachable, which is exactly why these rows sit behind the same
+        # fail-fast probe as the headline). Non-headline: a pp-ring step at
+        # these shapes is not tokens/s-comparable with the pp1 sweep.
+        if os.environ.get("BENCH_SCHEDULES", "1") != "0":
+            n_dev = jax.device_count()
+            pp_s = 4 if n_dev >= 4 else n_dev
+            m_s = int(os.environ.get("BENCH_SCHED_MICROBATCHES", "8"))
+            if pp_s < 2:
+                print("bench schedule rows skipped: one visible device "
+                      "(a pp ring needs >= 2 chips)", file=sys.stderr,
+                      flush=True)
+            else:
+                sched_mesh = make_mesh(MeshConfig(pp=pp_s))
+                sbatch = make_batch(m_s)  # one row per microbatch
+                stacked_by_v: dict[int, tuple] = {}  # v -> (manifest, params)
+            for sched, v_s in ((("1f1b", 1), ("interleaved_1f1b", 2),
+                                ("zb1", 2)) if pp_s >= 2 else ()):
+                if cfg.num_hidden_layers % (pp_s * v_s) or m_s % pp_s:
+                    print(f"bench schedule row {sched} skipped: "
+                          f"{cfg.num_hidden_layers} layers / m={m_s} do not "
+                          f"fit pp={pp_s} v={v_s}", file=sys.stderr, flush=True)
+                    continue
+                try:
+                    if v_s not in stacked_by_v:  # one ~550M re-stack per v
+                        man_s = StageManifest.for_config(cfg, pp_s,
+                                                         virtual_stages=v_s)
+                        stacked_by_v[v_s] = (man_s,
+                                             pl.stack_stages(canonical, man_s))
+                    man_s, stacked_s = stacked_by_v[v_s]
+                    pcfg_s = pl.PipelineConfig(
+                        num_stages=pp_s, num_microbatches=m_s,
+                        schedule=sched, virtual_stages=v_s)
+                    fn = jax.jit(pl.make_pipeline_loss_and_grad(
+                        sched_mesh, cfg, pcfg_s, stacked_s))
+                    float(fn(stacked_s, sbatch)[0])  # compile off the clock
+                    t0 = time.perf_counter()
+                    for _ in range(n_steps):
+                        last = float(fn(stacked_s, sbatch)[0])
+                    dt = (time.perf_counter() - t0) / n_steps
+                    if not np.isfinite(last):
+                        raise ValueError(f"non-finite loss {last}")
+                    detail = {
+                        "schedule": sched, "pp": pp_s,
+                        "virtual_stages": v_s, "microbatches": m_s,
+                        "bubble_fraction_analytic":
+                            round(pl.bubble_fraction(pcfg_s), 4)}
+                    if sched == "zb1":
+                        detail["wgrad_queue_depth"] = pl.wgrad_queue_peak(pcfg_s)
+                    results[f"extra:sched-{sched},pp={pp_s}"] = {
+                        "dt": dt, "tokens_per_step": m_s * seq,
+                        "headline": False, "detail": detail}
+                except Exception as e:
+                    print(f"bench schedule row {sched} pp={pp_s} v={v_s} "
+                          f"failed: {e!r}", file=sys.stderr, flush=True)
 
         # Serving microbench (BENCH_SERVING=0 skips): prefill TTFT + steady-
         # state per-token decode latency at fixed batch through the REAL

@@ -34,13 +34,17 @@ class StageManifest:
     # None -> even split (num_layers % num_stages must be 0). Otherwise one
     # count per stage, each >= 1, summing to num_layers.
     layer_counts: tuple | None = None
-    # Interleaved scheduling (schedule: interleaved_1f1b): each stage owns
-    # `virtual_stages` NON-CONTIGUOUS chunks of layers, assigned round-robin
-    # over global chunks — chunk c (of num_stages * virtual_stages equal
-    # chunks, in layer order) lives on stage c % num_stages as its virtual
-    # chunk c // num_stages, so the activation ring passes through every
-    # stage `virtual_stages` times per microbatch. 1 = the flat contiguous
-    # partition (every existing checkpoint/manifest deserializes to it).
+    # Interleaved scheduling (schedule: interleaved_1f1b or zb1): each stage
+    # owns `virtual_stages` NON-CONTIGUOUS chunks of layers, assigned
+    # round-robin over global chunks — chunk c (of num_stages *
+    # virtual_stages equal chunks, in layer order) lives on stage
+    # c % num_stages as its virtual chunk c // num_stages, so the activation
+    # ring passes through every stage `virtual_stages` times per microbatch.
+    # 1 = the flat contiguous partition (every existing checkpoint/manifest
+    # deserializes to it). The manifest is SCHEDULE-AGNOSTIC on disk: the
+    # canonical [num_layers, ...] checkpoint layout never changes, so any
+    # PR-2/PR-5 checkpoint restores into flat, interleaved, or zb1 layouts
+    # through the same stack_stages/unstack_stages pair.
     virtual_stages: int = 1
 
     def __post_init__(self) -> None:

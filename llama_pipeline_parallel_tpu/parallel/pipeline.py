@@ -13,7 +13,7 @@ Design (and why it is not a translation of DeepSpeed):
   (the analogue of `LayerSpec` lazy per-rank materialization, reference
   models/llama_ds_mp_wrap.py:209-224, but by sharding, not by construction
   order).
-- Three schedules, all skewed microbatch loops where activations hop to the
+- Four schedules, all skewed microbatch loops where activations hop to the
   next stage via `jax.lax.ppermute` over the ICI ring (the analogue of NCCL
   P2P send/recv):
   * "1f1b" (default) — the schedule DeepSpeed's engine runs: forward and
@@ -24,6 +24,12 @@ Design (and why it is not a translation of DeepSpeed):
     owns `virtual_stages` round-robin layer chunks, the activation laps the
     ring v times per microbatch, and the flush bubble drops ~2vx
     (see `_pipeline_interleaved_1f1b_local`; docs/SCHEDULES.md).
+  * "zb1" — the interleaved clock with the backward DECOMPOSED into B
+    (input-grad) and W (weight-grad) units, ZB-H1 / 2BP-style: B units
+    stay on the critical path, W units replay from stashed residuals in a
+    fourth collective-free phase, dropping the analytic bubble another
+    third below interleaved (`split_backward=True` on the same function;
+    docs/SCHEDULES.md has the unit accounting and the W-stash bound).
   * "gpipe" — forward-only scan; JAX autodiff yields the backward pipeline
     automatically (the transpose of `ppermute` is the reverse `ppermute`),
     at the cost of O(M) stored boundary activations.
@@ -80,7 +86,7 @@ Params = dict
 Batch = dict
 
 
-SCHEDULES = ("1f1b", "interleaved_1f1b", "gpipe")
+SCHEDULES = ("1f1b", "interleaved_1f1b", "zb1", "gpipe")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,10 +117,22 @@ class PipelineConfig:
     # (docs/SCHEDULES.md), at the cost of v x the ring hops and a ring
     # buffer of min(2vS-1, Mv) chunk inputs. Requires an even partition
     # with num_layers % (S*v) == 0 and microbatches-per-flush % S == 0.
+    # "zb1": ZB-H1-style zero-bubble decomposition of the interleaved
+    # schedule's backward tick into two separately schedulable units — B
+    # (input-grad only: the cotangent propagation the UPSTREAM stage is
+    # waiting on) and W (weight-grad only, replayed later from a stashed
+    # (chunk input, output cotangent) residual). B units stay on the
+    # critical-path tick clock; W units queue and drain into a fourth,
+    # collective-free phase, so the warmup/drain phases stop paying the
+    # weight-grad work the fused backward would mask (docs/SCHEDULES.md;
+    # 2BP arxiv 2405.18047, the substrate OptPipe-style solver schedules
+    # need). Composes with `virtual_stages` (v=1 is the flat form). Costs
+    # a W-stash of 2 x (Mv/accum_chunks) hidden-sized buffers per stage
+    # (tools/preflight.py models it) and the W unit's chunk recompute.
     # "gpipe": forward-only scan differentiated by AD — simpler graph, but
     # stores one stage-boundary activation per tick, so memory grows with M.
     schedule: str = "1f1b"
-    # Virtual pipeline chunks per stage (interleaved_1f1b only; 1 elsewhere).
+    # Virtual pipeline chunks per stage (interleaved_1f1b / zb1; 1 elsewhere).
     virtual_stages: int = 1
     # Split the microbatches into this many sequential pipeline flushes within
     # ONE jitted step, at the price of one extra (num_stages-1)-tick bubble
@@ -168,19 +186,20 @@ class PipelineConfig:
         if self.virtual_stages < 1:
             raise ValueError(
                 f"virtual_stages must be >= 1, got {self.virtual_stages}")
-        if self.virtual_stages > 1 and self.schedule != "interleaved_1f1b":
+        if self.virtual_stages > 1 and self.schedule not in (
+                "interleaved_1f1b", "zb1"):
             raise ValueError(
                 f"virtual_stages={self.virtual_stages} requires "
-                f"schedule=interleaved_1f1b (got {self.schedule!r})")
-        if self.schedule == "interleaved_1f1b":
+                f"schedule=interleaved_1f1b or zb1 (got {self.schedule!r})")
+        if self.schedule in ("interleaved_1f1b", "zb1"):
             if self.layer_counts is not None and len(set(self.layer_counts)) != 1:
                 raise ValueError(
-                    "interleaved_1f1b requires an even stage partition; "
+                    f"{self.schedule} requires an even stage partition; "
                     f"got layer_counts={self.layer_counts}")
             m_flush = self.num_microbatches // self.accum_chunks
             if self.virtual_stages > 1 and m_flush % self.num_stages:
                 raise ValueError(
-                    f"interleaved_1f1b with virtual_stages="
+                    f"{self.schedule} with virtual_stages="
                     f"{self.virtual_stages} needs microbatches-per-flush "
                     f"({self.num_microbatches}/{self.accum_chunks}="
                     f"{m_flush}) divisible by num_stages={self.num_stages} "
@@ -220,6 +239,21 @@ def bubble_fraction(pcfg: PipelineConfig) -> float:
       independent of the fwd/bwd cost split, ~2vx below flat 1f1b for
       m >> S (the v from the shorter fill, the 2 from warmup/drain ticks no
       longer paying the masked opposite half).
+    - "zb1": the backward is SPLIT into B (input-grad) and W (weight-grad)
+      units, so the cost split matters and the unit accounting goes to
+      thirds: F = B = W = 1 unit (the zero-bubble family's symmetric-cost
+      assumption — dL/dx = dy W^T and dL/dW = x^T dy are the same matmul
+      flops as the forward; W-unit recompute is charged to the backward
+      exactly as remat's recompute already is in every schedule above).
+      A full fused tick is F+B+W = 3 units. Per flush: vS-1 warmup ticks
+      cost F each, mv + S - vS steady ticks cost F+B, vS-1 drain ticks
+      cost B each (the W half the fused drain would pay is GONE — that is
+      the zb1 win), and the W queue drains in mv single-unit W ticks:
+      wall = (vS-1) + 2(mv + S - vS) + (vS-1) + mv = 3mv + 2(S-1) units,
+      3mv useful -> bubble = 2c(S-1) / (3Mv + 2c(S-1)) — strictly below
+      interleaved's 3c(S-1) / (3Mv + 3c(S-1)) for every S > 1
+      (docs/SCHEDULES.md pins the derivation; tests/test_zero_bubble.py
+      the ordering zb1 <= interleaved <= flat across the grid).
     - "gpipe": the forward scan is m + S - 1 ticks and the AD transpose
       mirrors it, m useful each way
       -> bubble = c(S-1) / (M + c(S-1)).
@@ -231,8 +265,36 @@ def bubble_fraction(pcfg: PipelineConfig) -> float:
     if pcfg.schedule == "interleaved_1f1b":
         mv = m * pcfg.virtual_stages
         return (s - 1) * c / (mv + (s - 1) * c)
+    if pcfg.schedule == "zb1":
+        mv = m * pcfg.virtual_stages
+        return 2 * (s - 1) * c / (3 * mv + 2 * (s - 1) * c)
     per_flush = 2 * (s - 1) if pcfg.schedule == "1f1b" else (s - 1)
     return per_flush * c / (m + per_flush * c)
+
+
+def wgrad_queue_peak(pcfg: PipelineConfig) -> int:
+    """Peak W-queue occupancy (stashed B/W residuals) under `schedule: zb1`
+    — schedule-determined, not data-dependent: every per-flush unit's
+    (chunk input, output cotangent) pair is queued by its B tick and popped
+    only in the W-drain phase, so the peak is the per-flush unit count
+    Mv / accum_chunks (raising accum_chunks is the stash-memory lever, at
+    the usual extra-flush bubble price). 0 for fused-backward schedules —
+    the wgrad_queue_depth metrics/health key (docs/OBSERVABILITY.md)."""
+    if pcfg.schedule != "zb1":
+        return 0
+    return (pcfg.num_microbatches // pcfg.accum_chunks) * pcfg.virtual_stages
+
+
+def wgrad_stash_bytes(pcfg: PipelineConfig, mb_rows: int, local_seqlen: int,
+                      hidden_size: int, dtype_bytes: int = 2) -> int:
+    """Per-device bytes of the zb1 W-stash: two hidden-sized buffers (chunk
+    input + output cotangent) per queued unit, at this shard's LOCAL
+    microbatch rows and (sp-sharded) sequence length. The term
+    tools/preflight.py adds to its memory model — XLA's compile-time
+    analysis counts the same buffers, this names them and sizes the
+    actionable remedy (accum_chunks) when they blow the headroom."""
+    return (2 * wgrad_queue_peak(pcfg) * mb_rows * local_seqlen
+            * hidden_size * dtype_bytes)
 
 
 # ---------------------------------------------------------------------------
@@ -603,7 +665,7 @@ def _act_stat_update_chunk(carry: tuple, y: jnp.ndarray, valid, ch, v: int
 def _sched_act_stats_zero(pcfg: PipelineConfig):
     """Schedule-appropriate zero activation-stat carry (shapes must agree
     across the accum_chunks fold)."""
-    if pcfg.schedule == "interleaved_1f1b":
+    if pcfg.schedule in ("interleaved_1f1b", "zb1"):
         return _act_stats_zero_chunks(pcfg.virtual_stages)
     return _ACT_STATS_ZERO()
 
@@ -1016,10 +1078,30 @@ def _pipeline_interleaved_1f1b_local(
     attn_fn: Callable,
     global_count: jnp.ndarray,
     collect_stats: bool = False,
+    split_backward: bool = False,
 ) -> tuple:
     """Interleaved one-forward-one-backward: virtual pipeline stages
     (Megatron-style, OptPipe/PAPERS.md trade space) with the SAME
     hand-written per-tick `jax.vjp` backward as the flat schedule.
+
+    `split_backward` (schedule: zb1) decomposes the fused per-tick backward
+    into the two separately schedulable units of the zero-bubble family
+    (ZB-H1 / 2BP, PAPERS.md): a **B unit** — input-grad only, the cotangent
+    the upstream stage is waiting on, computed by vjp'ing the chunk w.r.t.
+    its INPUT with params closed over (so XLA never builds the weight-grad
+    matmuls there) — and a **W unit** — weight-grad only, replayed later
+    from a stashed (chunk input, output cotangent) residual. B units keep
+    the steady/drain tick clock; every B tick pushes its residual into the
+    W queue, and a fourth, collective-free `lax.scan` phase drains the
+    queue after the ring goes quiet, folding each W unit's dparams into the
+    SAME fp32 accumulators in the SAME unit order as the fused backward —
+    which is why zb1 stays bit-identical to flat/interleaved (the fused
+    pullback computes (dparams, dx) from one residual set; splitting it
+    re-runs the identical chunk recompute + cotangent chain per unit and
+    changes only WHEN dparams are materialized, not what is summed).
+    The stash is the price: 2 x N hidden-sized buffers per flush
+    (N = m*v units; `wgrad_queue_peak` / `wgrad_stash_bytes`, checked by
+    tools/preflight.py). At v=1 this is the flat zero-bubble schedule.
 
     Runs INSIDE shard_map; returns this shard's (normalized loss, grads) —
     the caller psums. Each stage owns v = `virtual_stages` round-robin layer
@@ -1151,7 +1233,7 @@ def _pipeline_interleaved_1f1b_local(
             xbuf, jnp.where(f_valid, x_recv, old), slot_f, 0)
         return y_f, xbuf
 
-    def bwd_half(t, dy_recv, xbuf, gacc, loss_acc, act_stats):
+    def bwd_half(t, dy_recv, xbuf, gacc, loss_acc, act_stats, wq):
         g = t - (d_off - stage)
         b_valid = (g >= 0) & (g < n_units)
         g_c = jnp.clip(g, 0, n_units - 1)
@@ -1168,7 +1250,15 @@ def _pipeline_interleaved_1f1b_local(
             return chunk_fwd(p, x_in, ch_b, ids_b, pad_b, cos_b, sin_b,
                              targets_b, with_loss=True, loss_gate=b_valid)
 
-        (y_b, mb_sum), pullback = jax.vjp(h, params, x_in_b)
+        if split_backward:
+            # B unit (zb1): input-grad only. Params are CLOSED OVER, so the
+            # vjp never builds the weight-grad matmuls — the tick pays just
+            # the chunk recompute + the cotangent chain the upstream stage
+            # is waiting on. The (input, cotangent) residual is stashed for
+            # the W-drain phase below.
+            (y_b, mb_sum), pullback = jax.vjp(lambda x: h(params, x), x_in_b)
+        else:
+            (y_b, mb_sum), pullback = jax.vjp(h, params, x_in_b)
         if collect_stats:
             # chunk-boundary activation stats from the backward recompute,
             # indexed [v] by this unit's chunk (-> [S, v] after stitching)
@@ -1180,42 +1270,59 @@ def _pipeline_interleaved_1f1b_local(
         owns_loss = is_last & (ch_b == v - 1)
         dy_ct = jnp.where(b_valid & ~owns_loss, 1.0, 0.0).astype(cfg.dtype) * dy_recv
         loss_ct = jnp.where(b_valid, 1.0, 0.0) / global_count
-        dparams, dx = pullback((dy_ct, loss_ct))
-        gacc = jax.tree.map(jnp.add, gacc, dparams)
+        if split_backward:
+            (dx,) = pullback((dy_ct, loss_ct))
+            # W-queue push at slot g: every unit is stashed exactly once
+            # (b_valid covers [0, n_units)); predicated so warmup/drain
+            # clipping can never clobber slot 0 / n_units-1 after their
+            # valid write (the same contract as xbuf's predicated store).
+            wq_x, wq_dy = wq
+            old_x = jax.lax.dynamic_index_in_dim(wq_x, g_c, keepdims=False)
+            old_dy = jax.lax.dynamic_index_in_dim(wq_dy, g_c, keepdims=False)
+            wq_x = jax.lax.dynamic_update_index_in_dim(
+                wq_x, jnp.where(b_valid, x_in_b, old_x), g_c, 0)
+            wq_dy = jax.lax.dynamic_update_index_in_dim(
+                wq_dy, jnp.where(b_valid, dy_ct, old_dy), g_c, 0)
+            wq = (wq_x, wq_dy)
+        else:
+            dparams, dx = pullback((dy_ct, loss_ct))
+            gacc = jax.tree.map(jnp.add, gacc, dparams)
         loss_acc = loss_acc + jnp.where(b_valid, mb_sum, 0.0)
-        return dx, gacc, loss_acc, act_stats
+        return dx, gacc, loss_acc, act_stats, wq
 
-    # -- the three phases over one tick clock -------------------------------
+    # -- the phased tick clock: three ring phases (+ zb1's W drain) ---------
     # (ppermutes sit outside every cond and run phase-uniformly: the phase
     # boundary is a function of the tick index alone, identical on every
-    # stage, so no device ever skips a collective its peers execute)
+    # stage, so no device ever skips a collective its peers execute. The
+    # zb1 W-drain phase contains no collective at all — pure per-stage
+    # weight-grad replays — so it needs no clock agreement beyond the scan.)
 
     def warm_tick(carry, t):
-        x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats = carry
+        x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats, *wq = carry
         y_f, xbuf = fwd_half(t, x_recv, xbuf)
         x_next = (jax.lax.ppermute(y_f, AXIS_PP, fwd_perm)
                   if s_total > 1 else y_f)
-        return (x_next, dy_recv, xbuf, gacc, loss_acc, act_stats), None
+        return (x_next, dy_recv, xbuf, gacc, loss_acc, act_stats, *wq), None
 
     def steady_tick(carry, t):
-        x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats = carry
+        x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats, *wq = carry
         y_f, xbuf = fwd_half(t, x_recv, xbuf)
-        dx, gacc, loss_acc, act_stats = bwd_half(t, dy_recv, xbuf, gacc,
-                                                 loss_acc, act_stats)
+        dx, gacc, loss_acc, act_stats, wq = bwd_half(
+            t, dy_recv, xbuf, gacc, loss_acc, act_stats, tuple(wq))
         if s_total > 1:
             x_next = jax.lax.ppermute(y_f, AXIS_PP, fwd_perm)
             dy_next = jax.lax.ppermute(dx, AXIS_PP, bwd_perm)
         else:
             x_next, dy_next = y_f, dx
-        return (x_next, dy_next, xbuf, gacc, loss_acc, act_stats), None
+        return (x_next, dy_next, xbuf, gacc, loss_acc, act_stats, *wq), None
 
     def drain_tick(carry, t):
-        x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats = carry
-        dx, gacc, loss_acc, act_stats = bwd_half(t, dy_recv, xbuf, gacc,
-                                                 loss_acc, act_stats)
+        x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats, *wq = carry
+        dx, gacc, loss_acc, act_stats, wq = bwd_half(
+            t, dy_recv, xbuf, gacc, loss_acc, act_stats, tuple(wq))
         dy_next = (jax.lax.ppermute(dx, AXIS_PP, bwd_perm)
                    if s_total > 1 else dx)
-        return (x_recv, dy_next, xbuf, gacc, loss_acc, act_stats), None
+        return (x_recv, dy_next, xbuf, gacc, loss_acc, act_stats, *wq), None
 
     carry = (
         jnp.zeros(hidden_shape, cfg.dtype),
@@ -1225,6 +1332,14 @@ def _pipeline_interleaved_1f1b_local(
         jnp.float32(0.0),
         _act_stats_zero_chunks(v),
     )
+    if split_backward:
+        # the W queue: one (chunk input, output cotangent) residual per
+        # per-flush unit — the zb1 stash (wgrad_queue_peak slots; the
+        # memory term tools/preflight.py models and docs/SCHEDULES.md
+        # bounds). accum_chunks shrinks n_units, so chunking is the lever
+        # when this buffer blows the HBM headroom.
+        carry = carry + (jnp.zeros((n_units,) + hidden_shape, cfg.dtype),
+                         jnp.zeros((n_units,) + hidden_shape, cfg.dtype))
     if warm:
         carry, _ = jax.lax.scan(warm_tick, carry, jnp.arange(warm))
     if n_steady:
@@ -1233,7 +1348,35 @@ def _pipeline_interleaved_1f1b_local(
     if n_drain:
         carry, _ = jax.lax.scan(drain_tick, carry,
                                 jnp.arange(num_ticks - n_drain, num_ticks))
-    _, _, _, grads, loss_acc, act_stats = carry
+    _, _, _, grads, loss_acc, act_stats, *wq = carry
+
+    if split_backward:
+        # -- W drain: pop the queue in B-unit order and replay each unit's
+        # weight grads from its stashed residual. vjp w.r.t. PARAMS only
+        # (the stashed input is a constant), seeded with the stashed ring
+        # cotangent + the same loss cotangent the fused backward used —
+        # every unit here was live (b_valid held at push time), so the
+        # seed is exactly 1/global_count. Folding in ascending unit order
+        # keeps the fp32 accumulation order identical to the fused
+        # backward's, which is what preserves bit-exact parity.
+        wq_x, wq_dy = wq
+        loss_ct_w = jnp.float32(1.0) / global_count
+
+        def w_tick(gacc, g):
+            mb_w, ch_w = _bwd_unit_mb_chunk(g, s_total, v)
+            ids_w, pad_w, cos_w, sin_w, targets_w = mb_data(mb_w)
+            x_w = jax.lax.dynamic_index_in_dim(wq_x, g, keepdims=False)
+            dy_w = jax.lax.dynamic_index_in_dim(wq_dy, g, keepdims=False)
+
+            def h_p(p):
+                return chunk_fwd(p, x_w, ch_w, ids_w, pad_w, cos_w, sin_w,
+                                 targets_w, with_loss=True)
+
+            _, pullback = jax.vjp(h_p, params)
+            (dparams,) = pullback((dy_w, loss_ct_w))
+            return jax.tree.map(jnp.add, gacc, dparams), None
+
+        grads, _ = jax.lax.scan(w_tick, grads, jnp.arange(n_units))
     # loss_acc is nonzero on the last stage only (cond zero branch elsewhere)
     if collect_stats:
         return loss_acc / global_count, grads, act_stats
@@ -1263,9 +1406,16 @@ def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn,
     chunk_pcfg = dataclasses.replace(
         pcfg, num_microbatches=pcfg.num_microbatches // chunks, accum_chunks=1)
 
-    if pcfg.schedule in ("1f1b", "interleaved_1f1b"):
-        sched_fn = (_pipeline_1f1b_local if pcfg.schedule == "1f1b"
-                    else _pipeline_interleaved_1f1b_local)
+    if pcfg.schedule in ("1f1b", "interleaved_1f1b", "zb1"):
+        if pcfg.schedule == "1f1b":
+            sched_fn = _pipeline_1f1b_local
+        elif pcfg.schedule == "zb1":
+            # the interleaved phased clock with the backward SPLIT into
+            # B (input-grad) / W (weight-grad) units — docs/SCHEDULES.md
+            sched_fn = partial(_pipeline_interleaved_1f1b_local,
+                               split_backward=True)
+        else:
+            sched_fn = _pipeline_interleaved_1f1b_local
 
         def chunk_loss_and_grad(p, chunk_batch):
             out = sched_fn(p, chunk_batch, cfg, chunk_pcfg, attn_fn,
@@ -1332,7 +1482,7 @@ def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn,
     n = jax.lax.psum(n, (AXIS_DP, AXIS_SP))
     msq = jax.lax.pmax(msq_sum / jnp.maximum(n, 1.0),
                        AXIS_TP)  # tp replicas agree; pmax re-asserts it
-    if pcfg.schedule == "interleaved_1f1b":
+    if pcfg.schedule in ("interleaved_1f1b", "zb1"):
         v = pcfg.virtual_stages
         stage_msq = jax.lax.pmax(
             jnp.sum(msq_sum) / jnp.maximum(jnp.sum(n), 1.0), AXIS_TP)
@@ -1353,10 +1503,11 @@ def _check_stacked_layout(params_like: Params, pcfg: PipelineConfig) -> None:
     here means the manifest and the PipelineConfig came from different
     places; failing at build time beats a shape error deep inside shard_map."""
     shape = tuple(params_like["layers"]["attn"]["wq"].shape)
-    if pcfg.schedule == "interleaved_1f1b" and pcfg.virtual_stages > 1:
+    if (pcfg.schedule in ("interleaved_1f1b", "zb1")
+            and pcfg.virtual_stages > 1):
         if len(shape) != 5 or shape[1] != pcfg.virtual_stages:
             raise ValueError(
-                f"schedule=interleaved_1f1b (virtual_stages="
+                f"schedule={pcfg.schedule} (virtual_stages="
                 f"{pcfg.virtual_stages}) needs params stacked "
                 f"[S, v, k, ...] — build them with stack_stages on a "
                 f"StageManifest(virtual_stages={pcfg.virtual_stages}); got "
@@ -1365,7 +1516,8 @@ def _check_stacked_layout(params_like: Params, pcfg: PipelineConfig) -> None:
         raise ValueError(
             f"schedule={pcfg.schedule!r} expects flat-stacked params "
             f"[S, k, ...]; got a layer leaf of shape {shape} (stacked with "
-            f"a virtual_stages manifest? set schedule: interleaved_1f1b)")
+            f"a virtual_stages manifest? set schedule: interleaved_1f1b "
+            f"or zb1)")
 
 
 def make_pipeline_eval_fn(
@@ -1475,7 +1627,7 @@ def make_pipeline_loss_and_grad(
     if collect_stats:
         stats_specs = {"act_absmax_per_stage": P(AXIS_PP),
                        "act_rms_per_stage": P(AXIS_PP)}
-        if pcfg.schedule == "interleaved_1f1b":
+        if pcfg.schedule in ("interleaved_1f1b", "zb1"):
             # [1, v] local -> [S, v] global; the chunk axis is replicated
             stats_specs.update({"act_absmax_per_chunk": P(AXIS_PP),
                                 "act_rms_per_chunk": P(AXIS_PP)})

@@ -6,6 +6,16 @@ runs `num_microbatches` microbatches through the pipeline (fwd+bwd), reduces
 gradients across DP, clips, steps AdamW + LR schedule, and returns the mean
 loss — except here it is one XLA program with no Python in the hot loop.
 
+Gradient-accumulation contract across schedules: the pipeline hands this
+module ONE fully-accumulated fp32 gradient tree per step, whatever the
+schedule's internal unit decomposition — fused per-tick vjp grads (1f1b /
+interleaved), AD-of-the-scan (gpipe), or the zb1 split backward, whose
+W units fold their weight-grad outputs incrementally into the same fp32
+accumulators during the W-drain phase in fused-identical unit order
+(parallel/pipeline.py). Nothing downstream of `make_pipeline_loss_and_grad`
+branches on the schedule, which is what lets one optimizer/numerics path
+serve all four.
+
 ZeRO-1 (reference conf yaml `zero_optimization: stage 1` + reduce-scatter):
 optimizer moments are sharded over the `dp` axis via GSPMD sharding
 annotations — each dp replica owns a 1/dp slice of mu/nu, XLA inserts the

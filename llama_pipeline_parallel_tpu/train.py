@@ -100,15 +100,40 @@ def _packing_factor(cfg: dict) -> int:
 
 
 def _virtual_stages(cfg: dict) -> int:
-    """The `virtual_stages` knob (interleaved 1F1B, docs/SCHEDULES.md),
-    parsed in one place so trainer + preflight + manifest agree on it."""
+    """The `virtual_stages` knob (interleaved 1F1B / zb1,
+    docs/SCHEDULES.md), parsed in one place so trainer + preflight +
+    manifest agree on it."""
     v = int(cfg.get("virtual_stages", 1) or 1)
-    if v > 1 and cfg.get("pipeline_schedule", "1f1b") != "interleaved_1f1b":
+    if v > 1 and cfg.get("pipeline_schedule", "1f1b") not in (
+            "interleaved_1f1b", "zb1"):
         raise ValueError(
             f"virtual_stages={v} requires pipeline_schedule: "
-            f"interleaved_1f1b (got "
+            f"interleaved_1f1b or zb1 (got "
             f"{cfg.get('pipeline_schedule', '1f1b')!r})")
     return v
+
+
+def _schedule_static_scalars(pcfg: "pl.PipelineConfig") -> dict:
+    """Run-constant schedule telemetry repeated on every metrics line
+    (docs/OBSERVABILITY.md): the schedule name, its analytic bubble
+    fraction, and — under zb1 — the peak W-queue occupancy of the split
+    backward (0 elsewhere; omitted rather than an always-zero column)."""
+    out = {"schedule": pcfg.schedule,
+           "bubble_fraction": round(pl.bubble_fraction(pcfg), 4)}
+    if pcfg.schedule == "zb1":
+        out["wgrad_queue_depth"] = pl.wgrad_queue_peak(pcfg)
+    return out
+
+
+def _schedule_health_static(pcfg: "pl.PipelineConfig", topology: dict) -> dict:
+    """The static health.json payload: the topology block (whose `schedule`
+    field the elastic-restore contract records) plus, under zb1, the same
+    wgrad_queue_depth the metrics line carries — one construction for both
+    optimizer paths so the two sinks can never desynchronize."""
+    out = {"topology": topology}
+    if pcfg.schedule == "zb1":
+        out["wgrad_queue_depth"] = pl.wgrad_queue_peak(pcfg)
+    return out
 
 
 def build_manifest(cfg: dict, model_cfg: LlamaConfig, pp: int) -> StageManifest:
@@ -117,16 +142,17 @@ def build_manifest(cfg: dict, model_cfg: LlamaConfig, pp: int) -> StageManifest:
     per-stage layer_counts > cost-balanced (`stage_balance: cost`, the
     SURVEY §7.3-item-2 MFU lever) > even split. Indivisible layer counts
     fall back to cost-balanced automatically. `virtual_stages` > 1
-    (interleaved 1F1B) switches to the round-robin chunked layout — it
-    rejects uneven partitions (manifest.py), so layer_counts/stage_balance
-    cannot be combined with it."""
+    (interleaved 1F1B / zb1) switches to the round-robin chunked layout —
+    it rejects uneven partitions (manifest.py), so layer_counts/
+    stage_balance cannot be combined with it."""
     v = _virtual_stages(cfg)
     if v > 1:
         if cfg.get("layer_counts") or cfg.get("stage_balance", "even") == "cost":
             raise ValueError(
-                "virtual_stages > 1 (interleaved 1F1B) uses the round-robin "
-                "even chunk partition; layer_counts/stage_balance: cost "
-                "cannot apply — drop them or fall back to a flat schedule")
+                "virtual_stages > 1 (interleaved 1F1B / zb1) uses the "
+                "round-robin even chunk partition; layer_counts/"
+                "stage_balance: cost cannot apply — drop them or fall back "
+                "to a flat schedule")
         return StageManifest.for_config(model_cfg, pp, virtual_stages=v)
     if cfg.get("layer_counts"):
         return StageManifest(num_layers=model_cfg.num_hidden_layers,
@@ -718,9 +744,9 @@ def _run_training(cfg: dict) -> dict:
             cfg, model_cfg, mesh, loader, seq_length,
             resume_step, end_step, do_step, do_save, do_eval,
             extra_scalars=_host_scalars(collator, loader),
-            static_scalars={"bubble_fraction": round(pl.bubble_fraction(pcfg), 4)},
+            static_scalars=_schedule_static_scalars(pcfg),
             monitor=monitor, data_start=data_start,
-            health_static={"topology": topology})
+            health_static=_schedule_health_static(pcfg, topology))
     except BaseException:
         # join the in-flight commit, but never let ITS failure replace the
         # training exception that actually killed the run
@@ -1484,8 +1510,8 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
         cfg, model_cfg, mesh, loader, seq_length,
         resume_step, end_step, do_step, do_save, do_eval,
         extra_scalars=_host_scalars(collator, loader),
-        static_scalars={"bubble_fraction": round(pl.bubble_fraction(pcfg), 4)},
+        static_scalars=_schedule_static_scalars(pcfg),
         monitor=monitor, data_start=data_start,
-        health_static={"topology": topology})
+        health_static=_schedule_health_static(pcfg, topology))
     return _summarize(final_loss, preempted_at, end_step, len(loader),
                       output_dir)

@@ -368,11 +368,21 @@ def _pcfg(schedule, s, m, c=1, v=1):
     ("interleaved_1f1b", 2, 8, 4, 4, 4 / 36),
     # m per flush == accum chunks degenerate under interleaving: flush m=S
     ("interleaved_1f1b", 2, 4, 2, 2, 2 / 10),
+    # zb1 (split B/W backward): 2c(S-1) / (3Mv + 2c(S-1)) — unit terms,
+    # F=B=W (docs/SCHEDULES.md; test_zero_bubble.py pins the derivation
+    # and the zb1 <= interleaved <= flat ordering across the grid)
+    ("zb1", 4, 8, 1, 2, 6 / 54),
+    ("zb1", 8, 256, 1, 2, 14 / 1550),   # the 65B shape: 0.90% vs 1.35%
+    ("zb1", 4, 8, 2, 2, 12 / 60),
+    ("zb1", 4, 8, 1, 1, 6 / 30),        # flat zero-bubble form
+    ("zb1", 2, 4, 2, 2, 4 / 28),        # m per flush == accum chunks
+    ("zb1", 4, 2, 1, 1, 6 / 12),        # M < S
     # S=1: no pipeline, no bubble, any schedule/chunking/interleaving
     ("1f1b", 1, 8, 1, 1, 0.0),
     ("1f1b", 1, 8, 8, 1, 0.0),
     ("gpipe", 1, 8, 2, 1, 0.0),
     ("interleaved_1f1b", 1, 8, 1, 4, 0.0),
+    ("zb1", 1, 8, 1, 4, 0.0),
 ])
 def test_bubble_fraction_grid(schedule, s, m, c, v, expected):
     assert pl.bubble_fraction(_pcfg(schedule, s, m, c, v)) == pytest.approx(expected)

@@ -173,6 +173,7 @@ def preflight(cfg: dict, hbm_gb: float) -> dict:
         "devices": int(np.prod(list(mesh.shape.values()))),
         "global_batch_rows": global_batch,
         "seq": seq,
+        "schedule": pcfg.schedule,
         "arguments_gib": round(arg / gib, 2),
         "outputs_gib": round(out / gib, 2),
         "temp_gib": round(temp / gib, 2),
@@ -181,6 +182,30 @@ def preflight(cfg: dict, hbm_gb: float) -> dict:
         "hbm_budget_gib": hbm_gb,
         "fits": peak / gib <= hbm_gb,
     }
+    if pcfg.schedule == "zb1":
+        # The zb1 split backward stashes a (chunk input, ring cotangent)
+        # residual per queued W unit (docs/SCHEDULES.md "W-stash memory
+        # bound"). XLA's peak above already counts these buffers — the
+        # explicit term names the schedule's memory tax and sizes the
+        # remedy when it blows the headroom (see the FAIL message in
+        # main()): accum_chunks divides the per-flush queue.
+        mb_rows = int(cfg.get("per_device_train_batch_size", 1))
+        dtype_bytes = jax.numpy.dtype(model_cfg.dtype).itemsize
+        stash = pl.wgrad_stash_bytes(
+            pcfg, mb_rows, seq // max(mesh_cfg.sp, 1),
+            model_cfg.hidden_size, dtype_bytes)
+        report["wgrad_queue_depth"] = pl.wgrad_queue_peak(pcfg)
+        report["wgrad_stash_gib"] = round(stash / gib, 2)
+        headroom = hbm_gb - (peak - stash) / gib
+        if stash / gib > max(headroom, 0.0):
+            report["wgrad_stash_verdict"] = (
+                f"W-stash {report['wgrad_stash_gib']} GiB exceeds the "
+                f"{round(max(headroom, 0.0), 2)} GiB headroom left by the "
+                f"rest of the step — raise gradient_accumulation_chunks "
+                f"(halves the per-flush W-queue per doubling) or fall back "
+                f"to pipeline_schedule: interleaved_1f1b")
+        else:
+            report["wgrad_stash_verdict"] = "fits within headroom"
     if cfg.get("optimizer_offload"):
         # host side: fp32 masters + two fp32 Adam moments, sharded per
         # process (optim/offload.py keeps only each host's device shards)
@@ -318,6 +343,14 @@ def resume_compat(cfg: dict) -> dict | None:
         changed = sorted(k for k in current if source.get(k) != current[k])
         report["source_topology"] = source.get("layout", source)
         report["topology_changed"] = changed or "no"
+        if source.get("schedule") != current["schedule"]:
+            # a schedule change is as restore-relevant as a topology one:
+            # the stacked layout changes (flat [S,k] vs chunked [S,v,k])
+            # even though the canonical checkpoint restores into either
+            report["schedule_changed"] = (
+                f"{source.get('schedule', '1f1b')} -> {current['schedule']} "
+                f"(layout re-stacks from the canonical checkpoint; "
+                f"docs/SCHEDULES.md)")
     data_state = meta.get("data_state")
     if data_state:
         packing = int(cfg.get("packing_factor", 1) or 1)
@@ -447,6 +480,14 @@ def main(argv: list[str] | None = None) -> None:
     if not report["fits"]:
         print(f"preflight FAIL: per-device peak {report['per_device_peak_gib']} GiB "
               f"exceeds the {args.hbm_gb} GiB budget")
+        if "wgrad_queue_depth" in report:  # zb1 configs, even a tiny stash
+            # actionable zb1 guidance: the W-stash is the schedule's own
+            # memory tax, and accum_chunks is its dial (docs/SCHEDULES.md)
+            print(f"  zb1 W-stash: {report['wgrad_stash_gib']} GiB across "
+                  f"{report['wgrad_queue_depth']} queued units — raise "
+                  f"gradient_accumulation_chunks to shrink the per-flush "
+                  f"W-queue, or fall back to pipeline_schedule: "
+                  f"interleaved_1f1b")
         sys.exit(1)
     print("preflight OK")
 
