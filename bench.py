@@ -459,6 +459,79 @@ def main() -> None:
                     print(f"bench schedule row {sched} pp={pp_s} v={v_s} "
                           f"failed: {e!r}", file=sys.stderr, flush=True)
 
+        # Host-stash offload rows (BENCH_OFFLOAD=0 skips): the measured
+        # D2H/H2D host-link bandwidth (the number tools/preflight.py's
+        # --host-bw-gibps feasibility assumption should be fed) and the
+        # zb1 W-stash-offload step against its in-HBM twin, each row
+        # carrying the MODELED transfer time and stash-hide ratio next to
+        # the measured step time — one live TPU run = a model-vs-measured
+        # offload point. Behind the same fail-fast probe as everything
+        # else; on CPU the transfers are gated off (utils/host_stash.py),
+        # so the rows exist but measure the restructured schedule only.
+        if os.environ.get("BENCH_OFFLOAD", "1") != "0":
+            try:
+                from llama_pipeline_parallel_tpu.utils import host_stash
+
+                bw = host_stash.measure_transfer_bandwidth()
+                probe_gib = bw["probe_mib"] / 1024
+                results["extra:offload-bw"] = {
+                    "dt": probe_gib / max(bw["d2h_gibps"], 1e-9),
+                    "tokens_per_step": 0, "headline": False, "detail": bw}
+
+                n_dev = jax.device_count()
+                m_o = int(os.environ.get("BENCH_SCHED_MICROBATCHES", "8"))
+                # largest ring (4 then 2) whose v=2 partition + microbatch
+                # round-robin both divide — tiny's 4 layers land on pp=2
+                pp_o = next((p for p in (4, 2)
+                             if p <= n_dev and m_o % p == 0
+                             and cfg.num_hidden_layers % (2 * p) == 0), 0)
+                if pp_o:
+                    off_mesh = make_mesh(MeshConfig(pp=pp_o))
+                    man_o = StageManifest.for_config(cfg, pp_o,
+                                                     virtual_stages=2)
+                    stacked_o = pl.stack_stages(canonical, man_o)
+                    obatch = make_batch(m_o)
+                    dts = {}
+                    for wgrad in (False, True):
+                        pcfg_o = pl.PipelineConfig(
+                            num_stages=pp_o, num_microbatches=m_o,
+                            schedule="zb1", virtual_stages=2,
+                            offload_wgrad=wgrad)
+                        fn = jax.jit(pl.make_pipeline_loss_and_grad(
+                            off_mesh, cfg, pcfg_o, stacked_o))
+                        float(fn(stacked_o, obatch)[0])  # compile
+                        t0 = time.perf_counter()
+                        for _ in range(n_steps):
+                            last = float(fn(stacked_o, obatch)[0])
+                        dts[wgrad] = (time.perf_counter() - t0) / n_steps
+                        if not np.isfinite(last):
+                            raise ValueError(f"non-finite loss {last}")
+                    pcfg_on = pl.PipelineConfig(
+                        num_stages=pp_o, num_microbatches=m_o,
+                        schedule="zb1", virtual_stages=2, offload_wgrad=True)
+                    mb_o = obatch["input_ids"].shape[0] // m_o
+                    stash = pl.wgrad_stash_bytes(pcfg_on, mb_o, seq,
+                                                 cfg.hidden_size, 2)
+                    # every residual pair moves D2H once + H2D once
+                    transfer_s = 2 * stash / (
+                        min(bw["d2h_gibps"], bw["h2d_gibps"]) * (1 << 30))
+                    results[f"extra:offload-wgrad-stash,pp={pp_o}"] = {
+                        "dt": dts[True], "tokens_per_step": m_o * seq,
+                        "headline": False, "detail": {
+                            "schedule": "zb1", "pp": pp_o,
+                            "offload": "wgrad_stash",
+                            "pinned_host": bw["pinned_host"],
+                            "stash_mib": round(stash / (1 << 20), 1),
+                            "in_hbm_step_ms": round(1000 * dts[False], 1),
+                            "transfer_stall_ms":
+                                round(1000 * (dts[True] - dts[False]), 1),
+                            "transfer_ms_model": round(1000 * transfer_s, 2),
+                            "stash_hide_ratio":
+                                round(transfer_s / dts[False], 3)}}
+            except Exception as e:
+                print(f"bench offload rows failed: {e!r}", file=sys.stderr,
+                      flush=True)
+
         # Serving microbench (BENCH_SERVING=0 skips): prefill TTFT + steady-
         # state per-token decode latency at fixed batch through the REAL
         # continuous-batching engine (serve/engine.py), i.e. the numbers

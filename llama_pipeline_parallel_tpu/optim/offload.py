@@ -28,6 +28,15 @@ The update kernel is C++ (csrc/host_adamw.cpp, OpenMP parallel + SIMD),
 compiled on first use with the system g++ and bound via ctypes — no pybind11
 dependency. A pure-numpy fallback keeps the path alive where no compiler
 exists.
+
+This module is the HOST-side tier (python-driven D2H/kernel/H2D around the
+step); its IN-GRAPH sibling is `utils/host_stash.py`, which generalizes the
+same keep-cold-bytes-in-host-DRAM-behind-overlapped-transfers idea to the
+pipeline schedules' residual stores (the zb1 W queue, the stage-input ring
+buffer) with `jax.device_put`-to-memory-kind transfers XLA schedules
+asynchronously INSIDE the jitted step — see docs/SCHEDULES.md "Host
+offload". Measure the link both tiers share with
+`host_stash.measure_transfer_bandwidth` (bench.py `extra:offload-bw`).
 """
 
 from __future__ import annotations

@@ -79,7 +79,7 @@ from llama_pipeline_parallel_tpu.parallel.mesh import (
     AXIS_SP,
     AXIS_TP,
 )
-from llama_pipeline_parallel_tpu.utils import compat
+from llama_pipeline_parallel_tpu.utils import compat, host_stash
 from llama_pipeline_parallel_tpu.utils.compat import shard_map
 
 Params = dict
@@ -163,6 +163,21 @@ class PipelineConfig:
     # At sp=1 both attention backends already read segments from the mask,
     # so this knob only affects the sp wrappers.
     packed: bool = False
+    # Tier the zb1 W-queue residual pairs to host DRAM (utils/host_stash.py,
+    # config key `offload.wgrad_stash`): each B tick pushes its (chunk input,
+    # ring cotangent) pair D2H as it retires, and the W-drain phase
+    # prefetches pairs back H2D one unit ahead of the replay consuming them
+    # — the wgrad_stash_bytes term leaves HBM, which is what lets the 65B
+    # zb1 shape keep its batch rows (conf/llama_65b_pp8_zb1_offload_*.yaml)
+    # instead of funding the stash from them. Values round-trip bit-exactly;
+    # zb1-only (fused-backward schedules have no W queue).
+    offload_wgrad: bool = False
+    # Tier the schedules' stage-input ring buffer (the min(2vS-1, Mv)
+    # buffered boundary activations awaiting their backward recompute) to
+    # host DRAM — bounds the ring's HBM term so longer sequences / larger
+    # per-flush M fit per chip. 1f1b/interleaved/zb1 only: gpipe's stored
+    # activations are AD-internal (no explicit buffer to hook).
+    offload_activations: bool = False
 
     def __post_init__(self) -> None:
         from llama_pipeline_parallel_tpu.parallel.sp import SP_STRATEGIES
@@ -205,6 +220,17 @@ class PipelineConfig:
                     f"{m_flush}) divisible by num_stages={self.num_stages} "
                     f"(the round-robin unit groups hold one microbatch per "
                     f"stage)")
+        if self.offload_wgrad and self.schedule != "zb1":
+            raise ValueError(
+                f"offload.wgrad_stash requires schedule: zb1 (only the "
+                f"split backward stashes a W queue; got "
+                f"{self.schedule!r})")
+        if self.offload_activations and self.schedule == "gpipe":
+            raise ValueError(
+                "offload.activations requires a hand-written-backward "
+                "schedule (1f1b / interleaved_1f1b / zb1): gpipe's stored "
+                "activations are AD-internal, there is no explicit ring "
+                "buffer to tier")
         if self.layer_counts is not None:
             object.__setattr__(self, "layer_counts",
                                tuple(int(c) for c in self.layer_counts))
@@ -295,6 +321,61 @@ def wgrad_stash_bytes(pcfg: PipelineConfig, mb_rows: int, local_seqlen: int,
     actionable remedy (accum_chunks) when they blow the headroom."""
     return (2 * wgrad_queue_peak(pcfg) * mb_rows * local_seqlen
             * hidden_size * dtype_bytes)
+
+
+def activation_ring_slots(pcfg: PipelineConfig) -> int:
+    """Stage-input ring-buffer slots per flush — the schedules' in-flight
+    activation store (xbuf): min(2S-1, m) flat, min(2vS-1, mv) chunked
+    (the liveness bounds derived in _pipeline_1f1b_local /
+    _pipeline_interleaved_1f1b_local). 0 where no buffer exists (gpipe's
+    store is AD-internal; the flat schedule at S=1 skips its forward half
+    entirely)."""
+    s, v = pcfg.num_stages, pcfg.virtual_stages
+    m_flush = pcfg.num_microbatches // pcfg.accum_chunks
+    if pcfg.schedule == "gpipe":
+        return 0
+    if pcfg.schedule == "1f1b":
+        return min(2 * s - 1, m_flush) if s > 1 else 0
+    return min(2 * v * s - 1, m_flush * v)
+
+
+def activation_ring_bytes(pcfg: PipelineConfig, mb_rows: int,
+                          local_seqlen: int, hidden_size: int,
+                          dtype_bytes: int = 2) -> int:
+    """Per-device bytes of the stage-input ring buffer at this shard's
+    local microbatch shape — the HBM term `offload.activations` tiers to
+    host DRAM (tools/preflight.py's memory model subtracts/adds it when
+    enumerating candidates)."""
+    return (activation_ring_slots(pcfg) * mb_rows * local_seqlen
+            * hidden_size * dtype_bytes)
+
+
+def stash_dims(mb_rows: int, seqlen: int, sp: int, hidden_size: int,
+               dtype) -> tuple:
+    """The (mb_rows, local_seqlen, hidden_size, dtype_bytes) tuple every
+    ring/stash byte model here takes — ONE spelling shared by the trainer's
+    offload metrics (train.py), tools/preflight.py's memory model, and the
+    selection tests, so the consumers can never disagree on a shard's slot
+    shape. `seqlen` is the GLOBAL row length; sp-sharding is applied here."""
+    return (int(mb_rows), int(seqlen) // max(int(sp), 1), int(hidden_size),
+            jnp.dtype(dtype).itemsize)
+
+
+def host_stash_bytes(pcfg: PipelineConfig, mb_rows: int, local_seqlen: int,
+                     hidden_size: int, dtype_bytes: int = 2) -> int:
+    """Per-device bytes RESIDENT IN HOST DRAM under the enabled offload
+    knobs (the metrics line's offload_stash_resident_gib; includes each
+    host ring's one garbage slot — utils/host_stash.py). 0 with offload
+    off."""
+    slot = mb_rows * local_seqlen * hidden_size * dtype_bytes
+    total = 0
+    if pcfg.offload_wgrad:
+        total += wgrad_stash_bytes(pcfg, mb_rows, local_seqlen, hidden_size,
+                                   dtype_bytes) + 2 * slot
+    if pcfg.offload_activations and activation_ring_slots(pcfg):
+        total += activation_ring_bytes(pcfg, mb_rows, local_seqlen,
+                                       hidden_size, dtype_bytes) + slot
+    return total
 
 
 # ---------------------------------------------------------------------------
@@ -1008,11 +1089,17 @@ def _pipeline_1f1b_local(
             # recompute (slot is free: a colliding index would be >= b_slots
             # microbatches old, past its backward tick). The write is still
             # predicated so drain-phase ticks (fm clipped onto m_total-1) can
-            # never clobber a live slot.
+            # never clobber a live slot — via `where(valid, new, old)` in
+            # HBM, via the host stash's garbage slot when the ring tiers to
+            # host DRAM (utils/host_stash.py; an RMW on a host slot would
+            # bounce the old value H2D just to write it back).
             slot_f = fm_c % b_slots
-            old = jax.lax.dynamic_index_in_dim(xbuf, slot_f, keepdims=False)
-            xbuf = jax.lax.dynamic_update_index_in_dim(
-                xbuf, jnp.where(f_valid, x_recv, old), slot_f, 0)
+            if pcfg.offload_activations:
+                xbuf = host_stash.stash_push(xbuf, x_recv, slot_f, f_valid)
+            else:
+                old = jax.lax.dynamic_index_in_dim(xbuf, slot_f, keepdims=False)
+                xbuf = jax.lax.dynamic_update_index_in_dim(
+                    xbuf, jnp.where(f_valid, x_recv, old), slot_f, 0)
 
         # -- backward half: microbatch t - (2S - 2 - stage) ---------------
         # (at S=1 the schedule degenerates to one vjp per tick — there is no
@@ -1022,8 +1109,16 @@ def _pipeline_1f1b_local(
         b_valid = (bm >= 0) & (bm < m_total)
         bm_c = jnp.clip(bm, 0, m_total - 1)
         ids_b, pad_b, cos_b, sin_b, targets_b = mb_data(bm_c)
-        x_in_b = (jax.lax.dynamic_index_in_dim(xbuf, bm_c % b_slots, keepdims=False)
-                  if s_total > 1 else x_recv)
+        if s_total <= 1:
+            x_in_b = x_recv
+        elif pcfg.offload_activations:
+            # H2D fetch dispatched at the top of the backward half — the
+            # copy overlaps the forward half's compute above it (no data
+            # dependence between them; XLA's async copy-start/copy-done)
+            x_in_b = host_stash.stash_pop(xbuf, bm_c % b_slots)
+        else:
+            x_in_b = jax.lax.dynamic_index_in_dim(xbuf, bm_c % b_slots,
+                                                  keepdims=False)
 
         def h(p, x_in):
             return stage_fwd(p, x_in, ids_b, pad_b, cos_b, sin_b, targets_b,
@@ -1057,7 +1152,9 @@ def _pipeline_1f1b_local(
     carry0 = (
         jnp.zeros(hidden_shape, cfg.dtype),
         jnp.zeros(hidden_shape, cfg.dtype),
-        jnp.zeros((b_slots,) + hidden_shape, cfg.dtype),
+        (host_stash.stash_init(b_slots, hidden_shape, cfg.dtype)
+         if pcfg.offload_activations and s_total > 1
+         else jnp.zeros((b_slots,) + hidden_shape, cfg.dtype)),
         jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), params),
         jnp.float32(0.0),
         _ACT_STATS_ZERO(),
@@ -1226,11 +1323,17 @@ def _pipeline_interleaved_1f1b_local(
                         None, with_loss=False)
         # Buffer the raw received chunk input for the later backward
         # recompute; predicated so warmup/drain clipping never clobbers a
-        # live slot (same contract as the flat schedule's buffer).
+        # live slot (same contract as the flat schedule's buffer; under
+        # offload.activations the ring lives in host DRAM and predication
+        # routes invalid writes to the stash's garbage slot instead of the
+        # RMW — utils/host_stash.py).
         slot_f = f_c % b_slots
-        old = jax.lax.dynamic_index_in_dim(xbuf, slot_f, keepdims=False)
-        xbuf = jax.lax.dynamic_update_index_in_dim(
-            xbuf, jnp.where(f_valid, x_recv, old), slot_f, 0)
+        if pcfg.offload_activations:
+            xbuf = host_stash.stash_push(xbuf, x_recv, slot_f, f_valid)
+        else:
+            old = jax.lax.dynamic_index_in_dim(xbuf, slot_f, keepdims=False)
+            xbuf = jax.lax.dynamic_update_index_in_dim(
+                xbuf, jnp.where(f_valid, x_recv, old), slot_f, 0)
         return y_f, xbuf
 
     def bwd_half(t, dy_recv, xbuf, gacc, loss_acc, act_stats, wq):
@@ -1243,8 +1346,14 @@ def _pipeline_interleaved_1f1b_local(
         f_idx = ((g_c // (v * s_total)) * (v * s_total)
                  + ch_b * s_total + g_c % s_total)
         ids_b, pad_b, cos_b, sin_b, targets_b = mb_data(mb_b)
-        x_in_b = jax.lax.dynamic_index_in_dim(xbuf, f_idx % b_slots,
-                                              keepdims=False)
+        if pcfg.offload_activations:
+            # dispatched at the top of the backward half so the H2D copy
+            # overlaps the forward half's compute (steady phase) — see the
+            # flat schedule's identical hook
+            x_in_b = host_stash.stash_pop(xbuf, f_idx % b_slots)
+        else:
+            x_in_b = jax.lax.dynamic_index_in_dim(xbuf, f_idx % b_slots,
+                                                  keepdims=False)
 
         def h(p, x_in):
             return chunk_fwd(p, x_in, ch_b, ids_b, pad_b, cos_b, sin_b,
@@ -1276,13 +1385,20 @@ def _pipeline_interleaved_1f1b_local(
             # (b_valid covers [0, n_units)); predicated so warmup/drain
             # clipping can never clobber slot 0 / n_units-1 after their
             # valid write (the same contract as xbuf's predicated store).
+            # Under offload.wgrad_stash the queue lives in host DRAM: the
+            # pair goes D2H the tick its B unit retires, behind the tick's
+            # remaining compute (utils/host_stash.py).
             wq_x, wq_dy = wq
-            old_x = jax.lax.dynamic_index_in_dim(wq_x, g_c, keepdims=False)
-            old_dy = jax.lax.dynamic_index_in_dim(wq_dy, g_c, keepdims=False)
-            wq_x = jax.lax.dynamic_update_index_in_dim(
-                wq_x, jnp.where(b_valid, x_in_b, old_x), g_c, 0)
-            wq_dy = jax.lax.dynamic_update_index_in_dim(
-                wq_dy, jnp.where(b_valid, dy_ct, old_dy), g_c, 0)
+            if pcfg.offload_wgrad:
+                wq_x = host_stash.stash_push(wq_x, x_in_b, g_c, b_valid)
+                wq_dy = host_stash.stash_push(wq_dy, dy_ct, g_c, b_valid)
+            else:
+                old_x = jax.lax.dynamic_index_in_dim(wq_x, g_c, keepdims=False)
+                old_dy = jax.lax.dynamic_index_in_dim(wq_dy, g_c, keepdims=False)
+                wq_x = jax.lax.dynamic_update_index_in_dim(
+                    wq_x, jnp.where(b_valid, x_in_b, old_x), g_c, 0)
+                wq_dy = jax.lax.dynamic_update_index_in_dim(
+                    wq_dy, jnp.where(b_valid, dy_ct, old_dy), g_c, 0)
             wq = (wq_x, wq_dy)
         else:
             dparams, dx = pullback((dy_ct, loss_ct))
@@ -1327,7 +1443,9 @@ def _pipeline_interleaved_1f1b_local(
     carry = (
         jnp.zeros(hidden_shape, cfg.dtype),
         jnp.zeros(hidden_shape, cfg.dtype),
-        jnp.zeros((b_slots,) + hidden_shape, cfg.dtype),
+        (host_stash.stash_init(b_slots, hidden_shape, cfg.dtype)
+         if pcfg.offload_activations
+         else jnp.zeros((b_slots,) + hidden_shape, cfg.dtype)),
         jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), params),
         jnp.float32(0.0),
         _act_stats_zero_chunks(v),
@@ -1336,10 +1454,17 @@ def _pipeline_interleaved_1f1b_local(
         # the W queue: one (chunk input, output cotangent) residual per
         # per-flush unit — the zb1 stash (wgrad_queue_peak slots; the
         # memory term tools/preflight.py models and docs/SCHEDULES.md
-        # bounds). accum_chunks shrinks n_units, so chunking is the lever
-        # when this buffer blows the HBM headroom.
-        carry = carry + (jnp.zeros((n_units,) + hidden_shape, cfg.dtype),
-                         jnp.zeros((n_units,) + hidden_shape, cfg.dtype))
+        # bounds). accum_chunks shrinks n_units, so chunking is one lever
+        # when this buffer blows the HBM headroom; offload.wgrad_stash is
+        # the other — the queue then lives in host DRAM and HBM never
+        # holds more than the in-flight transfer slots.
+        if pcfg.offload_wgrad:
+            carry = carry + (
+                host_stash.stash_init(n_units, hidden_shape, cfg.dtype),
+                host_stash.stash_init(n_units, hidden_shape, cfg.dtype))
+        else:
+            carry = carry + (jnp.zeros((n_units,) + hidden_shape, cfg.dtype),
+                             jnp.zeros((n_units,) + hidden_shape, cfg.dtype))
     if warm:
         carry, _ = jax.lax.scan(warm_tick, carry, jnp.arange(warm))
     if n_steady:
@@ -1362,11 +1487,12 @@ def _pipeline_interleaved_1f1b_local(
         wq_x, wq_dy = wq
         loss_ct_w = jnp.float32(1.0) / global_count
 
-        def w_tick(gacc, g):
+        def w_replay(gacc, g, x_w, dy_w):
+            """One W unit: vjp the chunk w.r.t. PARAMS from its residual
+            pair and fold dparams into the fp32 accumulators (ascending
+            unit order = the fused backward's order = bit-exact parity)."""
             mb_w, ch_w = _bwd_unit_mb_chunk(g, s_total, v)
             ids_w, pad_w, cos_w, sin_w, targets_w = mb_data(mb_w)
-            x_w = jax.lax.dynamic_index_in_dim(wq_x, g, keepdims=False)
-            dy_w = jax.lax.dynamic_index_in_dim(wq_dy, g, keepdims=False)
 
             def h_p(p):
                 return chunk_fwd(p, x_w, ch_w, ids_w, pad_w, cos_w, sin_w,
@@ -1374,9 +1500,34 @@ def _pipeline_interleaved_1f1b_local(
 
             _, pullback = jax.vjp(h_p, params)
             (dparams,) = pullback((dy_w, loss_ct_w))
-            return jax.tree.map(jnp.add, gacc, dparams), None
+            return jax.tree.map(jnp.add, gacc, dparams)
 
-        grads, _ = jax.lax.scan(w_tick, grads, jnp.arange(n_units))
+        if pcfg.offload_wgrad:
+            # Double-buffered drain: the carry holds unit g's residual pair
+            # ALREADY in HBM (fetched one tick earlier), and the body's
+            # first dispatch is the H2D fetch of unit g+1 — no data
+            # dependence on the replay below it, so the copy streams behind
+            # unit g's weight-grad compute (the "prefetch one unit ahead"
+            # contract; the last tick's clipped prefetch is dead).
+            def w_tick_prefetch(carry, g):
+                gacc, x_w, dy_w = carry
+                g_next = jnp.minimum(g + 1, n_units - 1)
+                x_nxt = host_stash.stash_pop(wq_x, g_next)
+                dy_nxt = host_stash.stash_pop(wq_dy, g_next)
+                gacc = w_replay(gacc, g, x_w, dy_w)
+                return (gacc, x_nxt, dy_nxt), None
+
+            first = (host_stash.stash_pop(wq_x, jnp.int32(0)),
+                     host_stash.stash_pop(wq_dy, jnp.int32(0)))
+            (grads, _, _), _ = jax.lax.scan(
+                w_tick_prefetch, (grads,) + first, jnp.arange(n_units))
+        else:
+            def w_tick(gacc, g):
+                x_w = jax.lax.dynamic_index_in_dim(wq_x, g, keepdims=False)
+                dy_w = jax.lax.dynamic_index_in_dim(wq_dy, g, keepdims=False)
+                return w_replay(gacc, g, x_w, dy_w), None
+
+            grads, _ = jax.lax.scan(w_tick, grads, jnp.arange(n_units))
     # loss_acc is nonzero on the last stage only (cond zero branch elsewhere)
     if collect_stats:
         return loss_acc / global_count, grads, act_stats

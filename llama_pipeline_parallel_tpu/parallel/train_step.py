@@ -14,7 +14,11 @@ W units fold their weight-grad outputs incrementally into the same fp32
 accumulators during the W-drain phase in fused-identical unit order
 (parallel/pipeline.py). Nothing downstream of `make_pipeline_loss_and_grad`
 branches on the schedule, which is what lets one optimizer/numerics path
-serve all four.
+serve all four. The host-stash offload knobs (PipelineConfig.offload_wgrad
+/ offload_activations, utils/host_stash.py) change only WHERE the
+schedules' residual stores live (host DRAM vs HBM), never the gradient
+values or fold order — so they too are invisible downstream, and offload
+on/off stays bit-exact through this module's update unchanged.
 
 ZeRO-1 (reference conf yaml `zero_optimization: stage 1` + reduce-scatter):
 optimizer moments are sharded over the `dp` axis via GSPMD sharding

@@ -113,6 +113,45 @@ def _virtual_stages(cfg: dict) -> int:
     return v
 
 
+def _offload_flags(cfg: dict) -> tuple[bool, bool]:
+    """The `offload.*` config block (host-DRAM residual tiering,
+    docs/SCHEDULES.md "Host offload"), parsed in one place so trainer +
+    preflight agree: `wgrad_stash` tiers the zb1 W queue, `activations`
+    the schedules' stage-input ring buffer (utils/host_stash.py)."""
+    node = cfg.get("offload") or {}
+    if not isinstance(node, dict):
+        raise ValueError(
+            f"offload must be a mapping of tier knobs, e.g. "
+            f"offload: {{wgrad_stash: true}} — got {node!r}")
+    known = {"wgrad_stash", "activations"}
+    unknown = set(node) - known
+    if unknown:
+        raise ValueError(f"unknown offload.* key(s) {sorted(unknown)}; "
+                         f"known: {sorted(known)}")
+    return (bool(node.get("wgrad_stash", False)),
+            bool(node.get("activations", False)))
+
+
+def _offload_static(pcfg: "pl.PipelineConfig", mb_rows: int,
+                    local_seqlen: int, hidden_size: int,
+                    dtype_bytes: int) -> dict:
+    """Run-constant host-stash telemetry for the metrics line AND
+    health.json (docs/OBSERVABILITY.md): which residual stores are tiered
+    and how many GiB of them are resident in host DRAM. Empty with offload
+    off — no always-zero columns, the wgrad_queue_depth policy."""
+    tiers = [name for name, on in (("wgrad_stash", pcfg.offload_wgrad),
+                                   ("activations", pcfg.offload_activations))
+             if on]
+    if not tiers:
+        return {}
+    resident = pl.host_stash_bytes(pcfg, mb_rows, local_seqlen, hidden_size,
+                                   dtype_bytes)
+    return {"offload_stash": "+".join(tiers),
+            # 6 decimals: KiB resolution, so tiny-model smoke runs still
+            # report a nonzero residency
+            "offload_stash_resident_gib": round(resident / (1 << 30), 6)}
+
+
 def _schedule_static_scalars(pcfg: "pl.PipelineConfig") -> dict:
     """Run-constant schedule telemetry repeated on every metrics line
     (docs/OBSERVABILITY.md): the schedule name, its analytic bubble
@@ -171,6 +210,7 @@ def build_pipeline_config(cfg: dict, mesh_cfg: Any, manifest: StageManifest
                           ) -> "pl.PipelineConfig":
     """PipelineConfig from the run config — one construction for the trainer
     and tools/preflight.py."""
+    offload_wgrad, offload_acts = _offload_flags(cfg)
     return pl.PipelineConfig(
         num_stages=mesh_cfg.pp,
         num_microbatches=cfg.get("gradient_accumulation_steps", 1),
@@ -182,7 +222,9 @@ def build_pipeline_config(cfg: dict, mesh_cfg: Any, manifest: StageManifest
         sequence_parallel=cfg.get("sequence_parallel", "ring"),
         loss_chunks=cfg.get("loss_vocab_chunks", 1),
         layer_counts=None if manifest.is_even else manifest.stage_layer_counts,
-        packed=_packing_factor(cfg) > 1)
+        packed=_packing_factor(cfg) > 1,
+        offload_wgrad=offload_wgrad,
+        offload_activations=offload_acts)
 
 
 def build_dataset_and_collator(cfg: dict, model_cfg: LlamaConfig) -> tuple[Any, Any]:
@@ -557,6 +599,17 @@ def _run_training(cfg: dict) -> dict:
     # (pcfg.packed switches the ring's segment streams on).
     packing = _packing_factor(cfg)
     pcfg = build_pipeline_config(cfg, mesh_cfg, manifest)
+    if pcfg.offload_wgrad or pcfg.offload_activations:
+        from llama_pipeline_parallel_tpu.utils import host_stash
+
+        logger.info(
+            "host stash enabled (wgrad=%s activations=%s): %s",
+            pcfg.offload_wgrad, pcfg.offload_activations,
+            "pinned_host memory space — residuals tier to host DRAM"
+            if host_stash.transfers_enabled() else
+            "transfers gated off (no distinct host memory space on this "
+            "backend, or LPT_HOST_STASH_FORCE=0) — same schedule, stores "
+            "stay device-resident")
     topology = _topology_meta(mesh, pcfg)
     # Numerics observatory (docs/OBSERVABILITY.md "Numerics"): per-stage
     # training-dynamics stats computed in-graph, anomaly detection + the
@@ -739,14 +792,18 @@ def _run_training(cfg: dict) -> dict:
 
     do_eval = _make_evaluator(cfg, mesh, model_cfg, pcfg, stacked_template,
                               attn_fn, lambda: state_box[0].params)
+    off_static = _offload_static(pcfg, *pl.stash_dims(
+        micro_batch, seq_length, mesh_cfg.sp, model_cfg.hidden_size,
+        model_cfg.dtype))
     try:
         final_loss, preempted_at = _train_loop(
             cfg, model_cfg, mesh, loader, seq_length,
             resume_step, end_step, do_step, do_save, do_eval,
             extra_scalars=_host_scalars(collator, loader),
-            static_scalars=_schedule_static_scalars(pcfg),
+            static_scalars={**_schedule_static_scalars(pcfg), **off_static},
             monitor=monitor, data_start=data_start,
-            health_static=_schedule_health_static(pcfg, topology))
+            health_static={**_schedule_health_static(pcfg, topology),
+                           **off_static})
     except BaseException:
         # join the in-flight commit, but never let ITS failure replace the
         # training exception that actually killed the run
@@ -1506,12 +1563,16 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
 
     do_eval = _make_evaluator(cfg, mesh, model_cfg, pcfg, stacked_template,
                               attn_fn, lambda: device_params_box[0])
+    off_static = _offload_static(pcfg, *pl.stash_dims(
+        cfg.get("per_device_train_batch_size", 1), seq_length,
+        mesh.shape["sp"], model_cfg.hidden_size, model_cfg.dtype))
     final_loss, preempted_at = _train_loop(
         cfg, model_cfg, mesh, loader, seq_length,
         resume_step, end_step, do_step, do_save, do_eval,
         extra_scalars=_host_scalars(collator, loader),
-        static_scalars=_schedule_static_scalars(pcfg),
+        static_scalars={**_schedule_static_scalars(pcfg), **off_static},
         monitor=monitor, data_start=data_start,
-        health_static=_schedule_health_static(pcfg, topology))
+        health_static={**_schedule_health_static(pcfg, topology),
+                       **off_static})
     return _summarize(final_loss, preempted_at, end_step, len(loader),
                       output_dir)

@@ -1,0 +1,171 @@
+"""Host-DRAM staging layer for pipeline residuals (PipeOffload-style tiering).
+
+The generalization of the streaming idea `optim/offload.py` already proved
+for optimizer state — keep the big, cold bytes in host DRAM and stream them
+across the PCIe/DMA link behind device compute — applied to the two
+IN-GRAPH residual stores the pipeline schedules carry (PipeOffload, arxiv
+2503.01328; ROADMAP item 2):
+
+- the zb1 W-queue: every B tick stashes a `(chunk input, ring cotangent)`
+  residual pair that only the W-drain phase consumes. At the 65B
+  pp8/M=256/v=2 shape this is 2 x 512 hidden-sized buffers per device —
+  64 GiB at the reference micro-batch rows, the reason the zb1 config of
+  record had to fund its stash from the batch dimension (micro 8 -> 2).
+- the 1f1b/interleaved ring buffer of stage-boundary inputs: min(2vS-1, Mv)
+  buffered activations per flush whose only reader is a backward tick
+  several ticks later.
+
+Mechanism: `jax.device_put` to a MEMORY KIND inside the jitted program.
+XLA's host-offloading legalization turns the annotated values into
+host-resident buffers with asynchronous copy-start/copy-done pairs that the
+latency-hiding scheduler overlaps against the surrounding compute — no host
+callback, no Python in the loop, and the value round-trips bit-exactly
+(it is a copy, not a cast), which is why offload on/off stays bit-identical
+across the whole parity grid (tests/test_host_stash.py).
+
+Ring-buffer discipline (`stash_init`/`stash_push`/`stash_pop`): buffers get
+one extra GARBAGE slot and predicated writes route to it, so the schedules'
+clipped warmup/drain indices never need the read-modify-write
+(`where(valid, new, old)`) the in-HBM buffers used — an RMW on a
+host-resident slot would bounce the old value H2D just to write it back.
+
+Backend gating: TPU and GPU expose a distinct `pinned_host` memory space
+and take the real tiering; XLA-CPU has ONE flat address space, where this
+jax version's sharded-jit lowering stamps placement custom calls the SPMD
+partitioner then rejects (`Side-effect HLO must have sharding` — the
+default-memory-kind canonicalization skips the sharding attach). So the
+transfers are emitted only when `supports_host_memory()` — elsewhere
+`to_host`/`to_device` are identity and the SAME schedule code runs with
+the stores in regular memory (values identical either way: the transfer
+is a copy, not a cast). `LPT_HOST_STASH_FORCE=1` forces emission (CPU
+parity tests run real round-trips under plain jit, where the annotations
+lower cleanly); `=0` forces it off — the escape hatch if a real-TPU
+compile ever trips the same partitioner check. The trainer logs the
+resolved mode once. The transfers stay structurally async: tests pin that
+the jaxpr's stash traffic is `device_put` data movement only and the
+lowered step contains no host-sync primitive (callback/infeed/outfeed).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+try:  # public export pending upstream; the impl class is stable across 0.4.x
+    from jax.sharding import TransferToMemoryKind  # type: ignore
+except ImportError:  # pragma: no cover - exercised on the installed jax
+    from jax._src.sharding_impls import TransferToMemoryKind
+
+HOST = "pinned_host"
+DEVICE = "device"
+
+
+@functools.lru_cache(maxsize=None)
+def supports_host_memory(platform: str | None = None) -> bool:
+    """Whether the default device exposes a distinct `pinned_host` memory
+    space (TPU/GPU). False on XLA-CPU, where the annotations compile to
+    no-ops — the program is identical, the tiering just isn't real. Cached:
+    the answer is a property of the backend, probed once per process."""
+    try:
+        dev = jax.devices(platform)[0] if platform else jax.devices()[0]
+        return HOST in {m.kind for m in dev.addressable_memories()}
+    except Exception:
+        return False
+
+
+def transfers_enabled() -> bool:
+    """Whether to_host/to_device emit real memory-kind transfers (see the
+    module docstring's backend gating). Read at TRACE time, once per
+    compiled program; LPT_HOST_STASH_FORCE=1/0 overrides the capability
+    probe in either direction."""
+    force = os.environ.get("LPT_HOST_STASH_FORCE", "")
+    if force:
+        return force not in ("0", "false", "False")
+    return supports_host_memory()
+
+
+def to_host(tree: Any) -> Any:
+    """Move every array leaf to the host memory space (async D2H inside jit;
+    XLA emits copy-start/copy-done the scheduler overlaps with compute).
+    Identity where transfers are gated off — same values, device-resident."""
+    if not transfers_enabled():
+        return tree
+    return jax.tree.map(
+        lambda x: jax.device_put(x, TransferToMemoryKind(HOST)), tree)
+
+
+def to_device(tree: Any) -> Any:
+    """Move every array leaf back to device HBM (async H2D inside jit)."""
+    if not transfers_enabled():
+        return tree
+    return jax.tree.map(
+        lambda x: jax.device_put(x, TransferToMemoryKind(DEVICE)), tree)
+
+
+# ---------------------------------------------------------------------------
+# Host-resident ring buffers (the schedules' residual stores)
+# ---------------------------------------------------------------------------
+
+def stash_init(n_slots: int, shape: tuple[int, ...], dtype) -> jnp.ndarray:
+    """A host-resident [n_slots + 1, *shape] buffer; slot n_slots is the
+    garbage slot predicated writes land in (see stash_push)."""
+    return to_host(jnp.zeros((n_slots + 1,) + tuple(shape), dtype))
+
+
+def stash_push(buf: jnp.ndarray, value: jnp.ndarray, slot: jnp.ndarray,
+               valid: jnp.ndarray) -> jnp.ndarray:
+    """Write `value` D2H into `buf[slot]` when `valid`, else into the
+    garbage slot — the predication contract the schedules need (clipped
+    warmup/drain indices must never clobber a live slot) without the
+    read-modify-write an in-HBM `where(valid, new, old)` store uses."""
+    n_slots = buf.shape[0] - 1
+    target = jnp.where(valid, slot, n_slots)
+    return jax.lax.dynamic_update_index_in_dim(buf, to_host(value), target, 0)
+
+
+def stash_pop(buf: jnp.ndarray, slot: jnp.ndarray) -> jnp.ndarray:
+    """Read `buf[slot]` back H2D. Dispatch it as early in the tick as its
+    index is known: the copy-start then runs behind whatever compute sits
+    between the dispatch and the first use (the W-drain phase goes one
+    further and prefetches a whole unit ahead — parallel/pipeline.py)."""
+    return to_device(jax.lax.dynamic_index_in_dim(buf, slot, keepdims=False))
+
+
+# ---------------------------------------------------------------------------
+# Host-link bandwidth probe (bench.py `extra:offload-*` rows)
+# ---------------------------------------------------------------------------
+
+def measure_transfer_bandwidth(nbytes: int = 1 << 28, reps: int = 3) -> dict:
+    """Measured D2H/H2D bandwidth of the host link, GiB/s. The empirical
+    anchor for the preflight memory model's `--host-bw-gibps` feasibility
+    assumption (tools/preflight.py) — run it on a live chip (bench.py
+    `extra:offload-bw` row) and feed the number back. Uses real transfers
+    with hard sync points, so on CPU it reports memcpy bandwidth (the
+    tiering there is a no-op; the row is only meaningful on TPU/GPU)."""
+    import time
+
+    import numpy as np
+
+    n = max(nbytes // 4, 1)
+    host_buf = np.ones((n,), np.float32)
+    dev = jax.device_put(host_buf)
+    dev.block_until_ready()
+    gib = 1 << 30
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.device_put(host_buf).block_until_ready()
+    h2d = reps * host_buf.nbytes / (time.perf_counter() - t0) / gib
+
+    np.asarray(dev)  # warm the D2H path
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        np.asarray(dev)
+    d2h = reps * host_buf.nbytes / (time.perf_counter() - t0) / gib
+    return {"h2d_gibps": round(h2d, 2), "d2h_gibps": round(d2h, 2),
+            "probe_mib": round(host_buf.nbytes / (1 << 20), 1),
+            "pinned_host": supports_host_memory()}

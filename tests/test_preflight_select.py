@@ -1,0 +1,125 @@
+"""The preflight memory model's SELECTION pass, unit-tested as pure
+arithmetic (tools/preflight.py enumerate_candidates / select_schedule —
+no compile, no subprocess: the fast lane the CI Offload gate runs).
+
+Pins which candidate wins at degenerate shapes: plenty of HBM -> zb1 with
+nothing tiered; a stash-blown budget with a healthy host link -> zb1 +
+offload.wgrad_stash (the offload conf's story); the same budget with a
+starved link -> offload is REFUSED analytically and selection falls back
+to interleaved; an impossible base -> no winner at all."""
+
+import pytest
+
+import preflight  # tools/ on sys.path via conftest
+
+from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+
+# the 65B pp8 shape the configs of record run: 8 rows x 512 seq x 8192
+# hidden x bf16 -> one stash slot = 64 MiB (the ONE dims spelling every
+# consumer shares — parallel/pipeline.py:stash_dims)
+DIMS = pl.stash_dims(8, 512, 1, 8192, "bfloat16")
+S, M, LAYERS = 8, 256, 80
+COMPUTE = lambda pcfg: 60.0  # modeled step-compute seconds, accum-invariant
+
+
+def pick(base_gib, hbm, bw):
+    return preflight.select_schedule(
+        preflight.enumerate_candidates(S, M, LAYERS), base_gib, DIMS,
+        hbm, bw, COMPUTE)
+
+
+def test_grid_shape():
+    cands = preflight.enumerate_candidates(S, M, LAYERS)
+    # v=4 is layer-indivisible (80 % 32 != 0): only v in {1, 2} appears
+    assert {c.virtual_stages for c in cands} == {1, 2}
+    assert {c.schedule for c in cands} == {"1f1b", "interleaved_1f1b", "zb1"}
+    assert any(c.offload_wgrad for c in cands)
+    assert all(not c.offload_wgrad or c.schedule == "zb1" for c in cands)
+
+
+def test_plenty_of_hbm_picks_zb1_untiered():
+    """With room for the 64 GiB stash in HBM, zb1 v2 c1 wins on bubble and
+    the tie-break keeps every store on device (never move bytes for
+    nothing)."""
+    winner, rows = pick(base_gib=70.0, hbm=1000.0, bw=30.0)
+    assert winner["schedule"] == "zb1"
+    assert winner["virtual_stages"] == 2 and winner["accum_chunks"] == 1
+    assert not winner["offload_wgrad"] and not winner["offload_activations"]
+    assert winner["bubble_fraction"] == round(14 / 1550, 4)
+
+
+def test_stash_blown_budget_picks_wgrad_offload():
+    """The offload conf's exact story: base ~70 GiB + 64 GiB stash refuses
+    a 95 GiB part, but tiering the W queue to host (128 GiB of traffic
+    hiding inside a 60 s step at 30 GiB/s) keeps micro=8 AND the 0.90%
+    bubble."""
+    winner, rows = pick(base_gib=70.0, hbm=95.0, bw=30.0)
+    assert winner["schedule"] == "zb1" and winner["offload_wgrad"]
+    assert not winner["offload_activations"]  # ring fits; don't tier it
+    assert winner["bubble_fraction"] == round(14 / 1550, 4)
+    assert winner["est_peak_gib"] < 95.0
+    # the in-HBM zb1 candidate at the same shape was scored and refused
+    in_hbm = next(r for r in rows if r["schedule"] == "zb1"
+                  and r["virtual_stages"] == 2 and r["accum_chunks"] == 1
+                  and not r["offload_wgrad"] and not r["offload_activations"])
+    assert not in_hbm["feasible"] and in_hbm["why_not"] == "exceeds HBM budget"
+
+
+def test_starved_host_link_refuses_offload_falls_back_to_interleaved():
+    """At 0.5 GiB/s the 128 GiB stash can never hide inside the step:
+    every offload candidate is rejected ANALYTICALLY (hide_ratio, not a
+    live-run stall) and selection falls back to interleaved v2 — the
+    lowest-bubble schedule whose memory fits without the host."""
+    winner, rows = pick(base_gib=70.0, hbm=95.0, bw=0.5)
+    assert winner["schedule"] == "interleaved_1f1b"
+    assert winner["virtual_stages"] == 2 and winner["accum_chunks"] == 1
+    assert not winner["offload_wgrad"] and not winner["offload_activations"]
+    refused = [r for r in rows if r["offload_wgrad"]]
+    assert refused and all(not r["feasible"] for r in refused)
+    assert any(r["why_not"] == "offload traffic cannot hide behind compute"
+               for r in refused)
+
+
+def test_nothing_fits_returns_no_winner():
+    winner, rows = pick(base_gib=120.0, hbm=95.0, bw=30.0)
+    assert winner is None
+    assert all(not r["feasible"] for r in rows)
+
+
+def test_offload_traffic_arithmetic():
+    slot = 8 * 512 * 8192 * 2
+    wg = pl.PipelineConfig(num_stages=S, num_microbatches=M, schedule="zb1",
+                           virtual_stages=2, offload_wgrad=True)
+    # 2 buffers per unit x Mv units x 2 directions = 4 * 512 slots
+    assert preflight.offload_traffic_bytes(wg, DIMS) == 4 * 512 * slot
+    both = pl.PipelineConfig(num_stages=S, num_microbatches=M,
+                             schedule="zb1", virtual_stages=2,
+                             offload_wgrad=True, offload_activations=True)
+    assert preflight.offload_traffic_bytes(both, DIMS) == 6 * 512 * slot
+    # accum_chunks shifts WHEN bytes move, not how much
+    chunked = pl.PipelineConfig(num_stages=S, num_microbatches=M,
+                                schedule="zb1", virtual_stages=2,
+                                accum_chunks=4, offload_wgrad=True)
+    assert preflight.offload_traffic_bytes(chunked, DIMS) == 4 * 512 * slot
+    none = pl.PipelineConfig(num_stages=S, num_microbatches=M,
+                             schedule="zb1", virtual_stages=2)
+    assert preflight.offload_traffic_bytes(none, DIMS) == 0
+
+
+def test_feasibility_report_keys():
+    wg = pl.PipelineConfig(num_stages=S, num_microbatches=M, schedule="zb1",
+                           virtual_stages=2, offload_wgrad=True)
+    feas = preflight.offload_feasibility(wg, DIMS, step_compute_s=60.0,
+                                         host_bw_gibps=30.0)
+    assert feas["offload_traffic_gib_per_step"] == 128.0
+    assert feas["offload_hide_ratio"] == pytest.approx(128 / 30 / 60,
+                                                       abs=1e-3)
+
+
+def test_select_overrides_roundtrip():
+    winner, _ = pick(base_gib=70.0, hbm=95.0, bw=30.0)
+    line = preflight.select_overrides(winner)
+    assert "pipeline_schedule=zb1" in line
+    assert "virtual_stages=2" in line
+    assert "offload.wgrad_stash=true" in line
+    assert "offload.activations" not in line

@@ -46,7 +46,206 @@ def _mesh_product(config_path: str, overrides: list[str]) -> int:
     return n
 
 
-def preflight(cfg: dict, hbm_gb: float) -> dict:
+def _host_transfers_enabled() -> bool:
+    from llama_pipeline_parallel_tpu.utils import host_stash
+
+    return host_stash.transfers_enabled()
+
+
+def counted_device_terms_gib(pcfg, dims: tuple) -> float:
+    """GiB a GATED-OFF compile (no host memory space) keeps device-resident
+    for the schedule's ring/stash stores: the full buffers, plus the host
+    rings' garbage slots for the stores marked tiered — what must be
+    subtracted from an anchored compile's peak before re-adding the real
+    shape's terms (see preflight()'s anchored-compile mode)."""
+    from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+
+    mb_rows, local_seqlen, hidden_size, dtype_bytes = dims
+    slot = mb_rows * local_seqlen * hidden_size * dtype_bytes
+    total = (pl.activation_ring_bytes(pcfg, *dims)
+             + pl.wgrad_stash_bytes(pcfg, *dims))
+    if pcfg.offload_wgrad:
+        total += 2 * slot
+    if pcfg.offload_activations and pl.activation_ring_slots(pcfg):
+        total += slot
+    return total / (1 << 30)
+
+
+def _step_compute_seconds(model_cfg, mesh_cfg, pcfg, mb_rows: int, seq: int,
+                          mfu: float, chip_flops: float | None) -> float:
+    """Modeled per-device compute seconds of one training step: the
+    overlap budget the offload traffic must hide inside. Uses the same
+    train_flops_per_token the bench MFU math uses; each device sees its dp
+    shard's tokens through 1/(pp*tp*sp) of the model."""
+    from llama_pipeline_parallel_tpu.utils.metrics import (
+        detect_chip_peak_flops,
+        train_flops_per_token,
+    )
+
+    peak = chip_flops or detect_chip_peak_flops() or 197e12
+    tokens = mb_rows * pcfg.num_microbatches * seq
+    shards = mesh_cfg.pp * mesh_cfg.tp * mesh_cfg.sp
+    return train_flops_per_token(model_cfg, seq) * tokens / shards / (
+        peak * max(mfu, 1e-6))
+
+
+def offload_traffic_bytes(pcfg, dims: tuple) -> int:
+    """Host-link bytes ONE STEP moves for the enabled offload knobs, both
+    directions (every tiered residual goes D2H once at stash time and H2D
+    once at consume time; accum_chunks shifts WHEN, not how much): the
+    zb1 W queue moves 2 buffers per unit x Mv units x 2 directions, the
+    activation ring 1 buffer per unit x 2 directions."""
+    from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+
+    mb_rows, local_seqlen, hidden_size, dtype_bytes = dims
+    slot = mb_rows * local_seqlen * hidden_size * dtype_bytes
+    units = pcfg.num_microbatches * pcfg.virtual_stages
+    total = 0
+    if pcfg.offload_wgrad:
+        total += 4 * units * slot
+    if pcfg.offload_activations and pl.activation_ring_slots(pcfg):
+        total += 2 * units * slot
+    return total
+
+
+def offload_feasibility(pcfg, dims: tuple, step_compute_s: float,
+                        host_bw_gibps: float) -> dict:
+    """The bandwidth half of the memory model: modeled transfer seconds
+    over modeled compute seconds (`offload_hide_ratio`). Ratios <= 1 can
+    in principle hide entirely behind compute (XLA's async copies overlap
+    the scan phases — parallel/pipeline.py); ratios above it WILL stall
+    the step no matter how the copies are scheduled."""
+    gib = 1 << 30
+    traffic = offload_traffic_bytes(pcfg, dims)
+    transfer_s = traffic / (host_bw_gibps * gib)
+    return {
+        "offload_traffic_gib_per_step": round(traffic / gib, 2),
+        "offload_transfer_s_model": round(transfer_s, 3),
+        "offload_compute_s_model": round(step_compute_s, 3),
+        "offload_hide_ratio": round(transfer_s / max(step_compute_s, 1e-9),
+                                    3),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Schedule selection: enumerate (schedule, v, accum, offload) candidates
+# against the budget and pick analytically (OptPipe-style: solve for the
+# schedule/memory trade instead of hand-picking it — PAPERS.md 2510.05186)
+# ---------------------------------------------------------------------------
+
+def candidate_device_terms_gib(pcfg, dims: tuple) -> dict:
+    """The schedule-DEPENDENT device-memory terms of one candidate, GiB:
+    the stage-input ring buffer and (zb1) the W stash — each replaced by
+    two in-flight transfer slots when its store tiers to host. Everything
+    else in the step (weights, grads, optimizer, transient activations) is
+    schedule-independent at fixed batch shape, which is what lets selection
+    anchor on ONE compiled peak (see select_schedule)."""
+    from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+
+    gib = 1 << 30
+    mb_rows, local_seqlen, hidden_size, dtype_bytes = dims
+    slot = mb_rows * local_seqlen * hidden_size * dtype_bytes
+    ring = pl.activation_ring_bytes(pcfg, *dims)
+    stash = pl.wgrad_stash_bytes(pcfg, *dims)
+    ring_dev = min(ring, 2 * slot) if pcfg.offload_activations else ring
+    stash_dev = min(stash, 4 * slot) if pcfg.offload_wgrad else stash
+    return {"ring_gib": ring_dev / gib, "stash_gib": stash_dev / gib,
+            "host_gib": pl.host_stash_bytes(pcfg, *dims) / gib}
+
+
+def enumerate_candidates(num_stages: int, microbatches: int, num_layers: int,
+                         max_virtual: int = 4,
+                         accum_options: tuple = (1, 2, 4, 8)) -> list:
+    """Every valid PipelineConfig in the selection grid: schedule x
+    virtual_stages (layer-divisible) x accum_chunks (microbatch-divisible)
+    x offload tiers (wgrad for zb1, activations for all hand-written
+    backwards). Validity delegates to PipelineConfig's own constructor —
+    one source of truth for the divisibility rules."""
+    from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+
+    cands = []
+    for schedule in ("1f1b", "interleaved_1f1b", "zb1"):
+        vs = ((1,) if schedule == "1f1b" else
+              tuple(v for v in (1, 2, 4)
+                    if v <= max_virtual and num_layers % (num_stages * v) == 0))
+        for v in vs:
+            for c in accum_options:
+                offloads = [(False, False), (False, True)]
+                if schedule == "zb1":
+                    offloads += [(True, False), (True, True)]
+                for ow, oa in offloads:
+                    try:
+                        cands.append(pl.PipelineConfig(
+                            num_stages=num_stages,
+                            num_microbatches=microbatches,
+                            schedule=schedule, virtual_stages=v,
+                            accum_chunks=c, offload_wgrad=ow,
+                            offload_activations=oa))
+                    except ValueError:
+                        continue
+    return cands
+
+
+def select_schedule(candidates: list, base_gib: float, dims: tuple,
+                    hbm_gb: float, host_bw_gibps: float,
+                    step_compute_fn, hide_max: float = 1.0) -> tuple:
+    """Score every candidate against the HBM budget AND the host-bandwidth
+    bound, and pick the feasible one with the lowest analytic bubble
+    (ties: lower host residency first — never move bytes for nothing —
+    then lower device peak). `base_gib` is the schedule-independent
+    anchor: the as-written config's compiled device peak minus ITS ring
+    and stash terms. `step_compute_fn(pcfg) -> seconds` models the overlap
+    budget (accum_chunks does not change it — same flops, more flushes).
+    Returns (winner_row_or_None, all_rows)."""
+    from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+
+    rows = []
+    for pcfg in candidates:
+        terms = candidate_device_terms_gib(pcfg, dims)
+        est = base_gib + terms["ring_gib"] + terms["stash_gib"]
+        feas = offload_feasibility(pcfg, dims, step_compute_fn(pcfg),
+                                   host_bw_gibps)
+        fits_hbm = est <= hbm_gb
+        hides = feas["offload_hide_ratio"] <= hide_max
+        rows.append({
+            "schedule": pcfg.schedule, "virtual_stages": pcfg.virtual_stages,
+            "accum_chunks": pcfg.accum_chunks,
+            "offload_wgrad": pcfg.offload_wgrad,
+            "offload_activations": pcfg.offload_activations,
+            "est_peak_gib": round(est, 2) + 0.0,  # normalize -0.0
+            "host_stash_gib": round(terms["host_gib"], 2) + 0.0,
+            "bubble_fraction": round(pl.bubble_fraction(pcfg), 4),
+            "hide_ratio": feas["offload_hide_ratio"],
+            "feasible": fits_hbm and hides,
+            "why_not": None if fits_hbm and hides else
+                       ("exceeds HBM budget" if not fits_hbm else
+                        "offload traffic cannot hide behind compute"),
+        })
+    feasible = [r for r in rows if r["feasible"]]
+    winner = min(feasible, key=lambda r: (r["bubble_fraction"],
+                                          r["host_stash_gib"],
+                                          r["est_peak_gib"]),
+                 default=None)
+    return winner, rows
+
+
+def select_overrides(row: dict) -> str:
+    """The winning candidate as `key=value` config overrides — what the
+    operator (or the supervisor's layout ladder) pastes onto the launch
+    line to run the chosen schedule."""
+    parts = [f"pipeline_schedule={row['schedule']}",
+             f"virtual_stages={row['virtual_stages']}",
+             f"gradient_accumulation_chunks={row['accum_chunks']}"]
+    if row["offload_wgrad"]:
+        parts.append("offload.wgrad_stash=true")
+    if row["offload_activations"]:
+        parts.append("offload.activations=true")
+    return " ".join(parts)
+
+
+def preflight(cfg: dict, hbm_gb: float, host_bw_gibps: float = 30.0,
+              mfu: float = 0.45, hide_max: float = 1.0,
+              chip_flops: float | None = None) -> dict:
     """Lower + compile the training step ABSTRACTLY (no arrays materialize:
     65B fp32 masters never exist) and return the per-device byte breakdown."""
     import jax
@@ -76,6 +275,26 @@ def preflight(cfg: dict, hbm_gb: float) -> dict:
     # the trainer's own builders: the preflight must compile the SAME program
     manifest = build_manifest(cfg, model_cfg, mesh_cfg.pp)
     pcfg = build_pipeline_config(cfg, mesh_cfg, manifest)
+
+    # Anchored-compile mode for host-offload configs on backends that
+    # cannot express host memory (utils/host_stash.py gating — XLA-CPU,
+    # i.e. every CLI preflight): the gated-off compile holds the tiered
+    # stash DEVICE-resident, and XLA-CPU additionally over-counts stash
+    # buffers past 2^31 elements (~2.4x at the 65B micro-8 shape, where
+    # the same program at micro 2 — exactly 2^31 — and the whole 7B grid
+    # match the closed-form model to the 0.1 GiB). So the device peak is
+    # estimated from a compile of the SAME program at the smallest valid
+    # M (queue shrunk under the cliff), with the schedule's ring/stash
+    # terms swapped to the real shape analytically — every other term is
+    # M-independent (ring slots cap at 2vS-1; scan trip counts are free).
+    pcfg_real, anchor_m = pcfg, None
+    if ((pcfg.offload_wgrad or pcfg.offload_activations)
+            and not _host_transfers_enabled()):
+        m_min = pcfg.num_stages * pcfg.accum_chunks
+        if m_min < pcfg.num_microbatches:
+            anchor_m = m_min
+            cfg = {**cfg, "gradient_accumulation_steps": m_min}
+            pcfg = build_pipeline_config(cfg, mesh_cfg, manifest)
 
     # the trainer probes the collator for the real row length; the synthetic
     # dataset's seq_length is that probe's answer for these configs
@@ -167,45 +386,111 @@ def preflight(cfg: dict, hbm_gb: float) -> dict:
     alias = getattr(ma, "alias_size_in_bytes", 0)
     # donated state aliases into the outputs: alias bytes are counted once
     peak = arg + out + temp - alias
+    mb_rows = int(cfg.get("per_device_train_batch_size", 1))
+    dims = pl.stash_dims(mb_rows, seq, mesh_cfg.sp, model_cfg.hidden_size,
+                         model_cfg.dtype)
+    # Device-peak estimate for offload configs: a GATED-OFF compile holds
+    # the tiered stash in regular memory (one flat address space on that
+    # backend), so the modeled host bytes are subtracted — via the anchored
+    # mode above when it applies, directly otherwise. When transfers are
+    # REAL (pinned_host exists), the compile already placed the stash in
+    # the host space and the raw peak is taken as-is — subtracting there
+    # would double-count the relief and understate device HBM by the whole
+    # stash (whether memory_analysis excludes host-space buffers is a
+    # calibration question; taking the raw number can only overstate).
+    host_bytes = pl.host_stash_bytes(pcfg_real, *dims)
+    if anchor_m:
+        terms_real = candidate_device_terms_gib(pcfg_real, dims)
+        peak_device_gib = (peak / gib - counted_device_terms_gib(pcfg, dims)
+                           + terms_real["ring_gib"] + terms_real["stash_gib"])
+    elif host_bytes and not _host_transfers_enabled():
+        peak_device_gib = (peak - host_bytes) / gib
+    else:
+        peak_device_gib = peak / gib
     report = {
         "compiled_path": "offload_loss_and_grad" if cfg.get("optimizer_offload")
                          else "fused_train_step",
         "devices": int(np.prod(list(mesh.shape.values()))),
-        "global_batch_rows": global_batch,
+        "global_batch_rows": mb_rows * pcfg_real.num_microbatches
+                             * mesh_cfg.dp,
         "seq": seq,
-        "schedule": pcfg.schedule,
+        "schedule": pcfg_real.schedule,
         "arguments_gib": round(arg / gib, 2),
         "outputs_gib": round(out / gib, 2),
         "temp_gib": round(temp / gib, 2),
         "aliased_gib": round(alias / gib, 2),
-        "per_device_peak_gib": round(peak / gib, 2),
+        "per_device_peak_gib": round(peak_device_gib, 2),
         "hbm_budget_gib": hbm_gb,
-        "fits": peak / gib <= hbm_gb,
+        "fits": peak_device_gib <= hbm_gb,
     }
-    if pcfg.schedule == "zb1":
+    if anchor_m:
+        report["anchor_microbatches"] = anchor_m
+        report["anchor_peak_gib"] = round(peak / gib, 2)
+        report["anchor_note"] = (
+            f"device peak estimated from an M={anchor_m} compile of the "
+            f"same program (this backend cannot express host memory, so a "
+            f"full-M compile would hold the tiered stash device-resident, "
+            f"and XLA-CPU over-counts stash buffers past 2^31 elements); "
+            f"ring/stash terms re-added analytically at "
+            f"M={pcfg_real.num_microbatches}")
+    if host_bytes:
+        if not anchor_m and not _host_transfers_enabled():
+            report["xla_raw_peak_gib"] = round(peak / gib, 2)
+        report["host_stash_gib"] = round(host_bytes / gib, 2)
+        report["offload"] = "+".join(
+            n for n, on in (("wgrad_stash", pcfg_real.offload_wgrad),
+                            ("activations", pcfg_real.offload_activations))
+            if on)
+    if pcfg_real.schedule == "zb1":
         # The zb1 split backward stashes a (chunk input, ring cotangent)
         # residual per queued W unit (docs/SCHEDULES.md "W-stash memory
-        # bound"). XLA's peak above already counts these buffers — the
-        # explicit term names the schedule's memory tax and sizes the
-        # remedy when it blows the headroom (see the FAIL message in
-        # main()): accum_chunks divides the per-flush queue.
-        mb_rows = int(cfg.get("per_device_train_batch_size", 1))
-        dtype_bytes = jax.numpy.dtype(model_cfg.dtype).itemsize
-        stash = pl.wgrad_stash_bytes(
-            pcfg, mb_rows, seq // max(mesh_cfg.sp, 1),
-            model_cfg.hidden_size, dtype_bytes)
-        report["wgrad_queue_depth"] = pl.wgrad_queue_peak(pcfg)
+        # bound"). The explicit term names the schedule's memory tax and
+        # sizes the remedies when it blows the headroom (see the FAIL
+        # message in main()): accum_chunks divides the per-flush queue,
+        # offload.wgrad_stash tiers it to host DRAM entirely.
+        stash = pl.wgrad_stash_bytes(pcfg_real, *dims)
+        report["wgrad_queue_depth"] = pl.wgrad_queue_peak(pcfg_real)
         report["wgrad_stash_gib"] = round(stash / gib, 2)
-        headroom = hbm_gb - (peak - stash) / gib
-        if stash / gib > max(headroom, 0.0):
+        if pcfg_real.offload_wgrad:
             report["wgrad_stash_verdict"] = (
-                f"W-stash {report['wgrad_stash_gib']} GiB exceeds the "
-                f"{round(max(headroom, 0.0), 2)} GiB headroom left by the "
-                f"rest of the step — raise gradient_accumulation_chunks "
-                f"(halves the per-flush W-queue per doubling) or fall back "
-                f"to pipeline_schedule: interleaved_1f1b")
+                "tiered to host DRAM (offload.wgrad_stash) — HBM holds "
+                "only the in-flight transfer slots")
         else:
-            report["wgrad_stash_verdict"] = "fits within headroom"
+            headroom = hbm_gb - (peak_device_gib - stash / gib)
+            if stash / gib > max(headroom, 0.0):
+                report["wgrad_stash_verdict"] = (
+                    f"W-stash {report['wgrad_stash_gib']} GiB exceeds the "
+                    f"{round(max(headroom, 0.0), 2)} GiB headroom left by "
+                    f"the rest of the step — raise "
+                    f"gradient_accumulation_chunks (halves the per-flush "
+                    f"W-queue per doubling), enable offload.wgrad_stash "
+                    f"(tiers the queue to host DRAM behind overlapped "
+                    f"transfers), or fall back to pipeline_schedule: "
+                    f"interleaved_1f1b")
+            else:
+                report["wgrad_stash_verdict"] = "fits within headroom"
+    if pcfg_real.offload_wgrad or pcfg_real.offload_activations:
+        # Host-bandwidth feasibility (the PipeOffload bound): the stash
+        # traffic must stream behind the step's compute, or the offload
+        # trades an OOM for a stall — rejected HERE, analytically, not
+        # discovered on device.
+        feas = offload_feasibility(
+            pcfg_real, dims,
+            _step_compute_seconds(model_cfg, mesh_cfg, pcfg_real, mb_rows,
+                                  seq, mfu, chip_flops),
+            host_bw_gibps)
+        report.update(feas)
+        if feas["offload_hide_ratio"] > hide_max:
+            report["fits"] = False
+            report["offload_bw_verdict"] = (
+                f"offload traffic cannot hide behind compute: modeled "
+                f"transfer time is {feas['offload_hide_ratio']:.2f}x the "
+                f"step's compute at {host_bw_gibps} GiB/s host bandwidth "
+                f"(--host-bw-gibps) and {mfu} MFU — raise "
+                f"gradient_accumulation_chunks, shrink the stash, or drop "
+                f"the offload")
+        else:
+            report["offload_bw_verdict"] = "hides behind compute"
     if cfg.get("optimizer_offload"):
         # host side: fp32 masters + two fp32 Adam moments, sharded per
         # process (optim/offload.py keeps only each host's device shards)
@@ -423,6 +708,27 @@ def main(argv: list[str] | None = None) -> None:
                         "and print each memory_analysis() peak — the error "
                         "bar for every CPU-estimate verdict (tpu needs the "
                         "tunnel; AOT only, runs nothing)")
+    p.add_argument("--select", action="store_true",
+                   help="after the as-written verdict, enumerate "
+                        "(schedule, virtual_stages, accum_chunks, offload) "
+                        "candidates against the HBM budget + host-bandwidth "
+                        "bound and print the analytically chosen config "
+                        "(OptPipe-style selection; docs/SCHEDULES.md "
+                        "'Host offload')")
+    p.add_argument("--host-bw-gibps", type=float, default=30.0,
+                   help="assumed host-link bandwidth, GiB/s, for the "
+                        "offload feasibility bound (measure the real one "
+                        "with bench.py's extra:offload-bw row)")
+    p.add_argument("--mfu", type=float, default=0.45,
+                   help="assumed MFU for the modeled step-compute time the "
+                        "offload traffic must hide inside (higher = "
+                        "stricter: faster compute leaves less hiding room)")
+    p.add_argument("--hide-ratio-max", type=float, default=1.0,
+                   help="reject offload whose modeled transfer/compute "
+                        "ratio exceeds this")
+    p.add_argument("--chip-flops", type=float, default=None,
+                   help="chip peak FLOP/s for the compute model (default: "
+                        "detect, else 197e12)")
     p.add_argument("overrides", nargs="*", help="key=value config overrides")
     args, unknown = p.parse_known_args(argv)
     bad = [u for u in unknown if not (u.startswith("--") and "=" in u)]
@@ -469,7 +775,9 @@ def main(argv: list[str] | None = None) -> None:
     cfg = load_config(args.config, args.overrides)
     print(f"preflight: {args.config} on {n_devices} virtual devices "
           f"(XLA-CPU estimate; TPU layouts/Mosaic VMEM differ — keep margin)")
-    report = preflight(cfg, args.hbm_gb)
+    report = preflight(cfg, args.hbm_gb, host_bw_gibps=args.host_bw_gibps,
+                       mfu=args.mfu, hide_max=args.hide_ratio_max,
+                       chip_flops=args.chip_flops)
     for k, v in report.items():
         print(f"  {k}: {v}")
     resume = resume_compat(cfg)
@@ -477,19 +785,85 @@ def main(argv: list[str] | None = None) -> None:
         print("resume preflight (elastic — docs/RESILIENCE.md):")
         for k, v in resume.items():
             print(f"  {k}: {v}")
+    if args.select:
+        _print_selection(cfg, report, args)
     if not report["fits"]:
         print(f"preflight FAIL: per-device peak {report['per_device_peak_gib']} GiB "
-              f"exceeds the {args.hbm_gb} GiB budget")
-        if "wgrad_queue_depth" in report:  # zb1 configs, even a tiny stash
+              f"exceeds the {args.hbm_gb} GiB budget"
+              if "offload_bw_verdict" not in report
+              or report["offload_hide_ratio"] <= args.hide_ratio_max else
+              f"preflight FAIL: {report['offload_bw_verdict']}")
+        if "wgrad_queue_depth" in report and not report.get("offload"):
             # actionable zb1 guidance: the W-stash is the schedule's own
-            # memory tax, and accum_chunks is its dial (docs/SCHEDULES.md)
+            # memory tax, with two dials and a fallback (docs/SCHEDULES.md)
             print(f"  zb1 W-stash: {report['wgrad_stash_gib']} GiB across "
                   f"{report['wgrad_queue_depth']} queued units — raise "
                   f"gradient_accumulation_chunks to shrink the per-flush "
-                  f"W-queue, or fall back to pipeline_schedule: "
+                  f"W-queue, enable offload.wgrad_stash to tier it to host "
+                  f"DRAM, or fall back to pipeline_schedule: "
                   f"interleaved_1f1b")
         sys.exit(1)
     print("preflight OK")
+
+
+def _print_selection(cfg: dict, report: dict, args) -> None:
+    """The --select pass: anchor on the compiled peak, enumerate the
+    candidate grid, print the scored table + the chosen config (or why
+    nothing fits). Pure arithmetic after the one compile the as-written
+    report already paid for."""
+    from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+    from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig
+    from llama_pipeline_parallel_tpu.train import (
+        build_manifest,
+        build_model_config,
+        build_pipeline_config,
+    )
+
+    mesh_cfg = MeshConfig(**cfg.get("mesh", {}))
+    model_cfg = build_model_config(cfg["model"])
+    manifest = build_manifest(cfg, model_cfg, mesh_cfg.pp)
+    pcfg = build_pipeline_config(cfg, mesh_cfg, manifest)
+    import jax.numpy as jnp
+
+    mb_rows = int(cfg.get("per_device_train_batch_size", 1))
+    seq = report["seq"]
+    dims = pl.stash_dims(mb_rows, seq, mesh_cfg.sp, model_cfg.hidden_size,
+                         model_cfg.dtype)
+    # schedule-independent anchor: the compiled DEVICE peak minus the
+    # as-written config's own ring/stash terms
+    terms = candidate_device_terms_gib(pcfg, dims)
+    base = report["per_device_peak_gib"] - terms["ring_gib"] - terms["stash_gib"]
+    compute_fn = lambda c: _step_compute_seconds(
+        model_cfg, mesh_cfg, c, mb_rows, seq, args.mfu, args.chip_flops)
+    winner, rows = select_schedule(
+        enumerate_candidates(mesh_cfg.pp, pcfg.num_microbatches,
+                             model_cfg.num_hidden_layers),
+        base, dims, args.hbm_gb, args.host_bw_gibps, compute_fn,
+        hide_max=args.hide_ratio_max)
+    print(f"schedule selection ({len(rows)} candidates; base "
+          f"{round(base, 2)} GiB + per-candidate ring/stash; "
+          f"bw {args.host_bw_gibps} GiB/s, mfu {args.mfu}):")
+    print(f"  {'schedule':<17} {'v':>2} {'c':>2} {'offload':<12} "
+          f"{'peak GiB':>9} {'host GiB':>9} {'bubble%':>8} {'hide':>6}  verdict")
+    for r in sorted(rows, key=lambda r: (not r["feasible"],
+                                         r["bubble_fraction"])):
+        off = "+".join(n for n, on in (("wgrad", r["offload_wgrad"]),
+                                       ("acts", r["offload_activations"]))
+                       if on) or "-"
+        mark = "*" if r is winner else " "
+        print(f" {mark}{r['schedule']:<17} {r['virtual_stages']:>2} "
+              f"{r['accum_chunks']:>2} {off:<12} {r['est_peak_gib']:>9} "
+              f"{r['host_stash_gib']:>9} "
+              f"{100 * r['bubble_fraction']:>8.2f} {r['hide_ratio']:>6} "
+              f" {'OK' if r['feasible'] else r['why_not']}")
+    if winner is None:
+        print("selection: NO feasible candidate — grow the mesh (tp/pp) or "
+              "shrink the batch shape")
+    else:
+        print(f"selected: {select_overrides(winner)}  "
+              f"(est peak {winner['est_peak_gib']} GiB, bubble "
+              f"{100 * winner['bubble_fraction']:.2f}%, host stash "
+              f"{winner['host_stash_gib']} GiB)")
 
 
 if __name__ == "__main__":
