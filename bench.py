@@ -258,7 +258,8 @@ def main() -> None:
     def measure(remat: bool, attn_name: str, batch_size: int,
                 loss_chunks: int = 1, trace_dir: str | None = None,
                 seq_len: int | None = None, packed: bool = False,
-                offload: bool = False) -> float | None:
+                offload: bool = False, kernel_ce: bool = False,
+                kernel_prologue: bool = False) -> float | None:
         """Mean steady-state step seconds for one config; None if it fails
         (e.g. flash unsupported shape / OOM with remat off) or its loss is
         not finite (a fast-but-broken config must never win the headline).
@@ -272,7 +273,9 @@ def main() -> None:
             batch = make_batch(batch_size, seq_len, packed)
             attn_fn = flash_attention if attn_name == "flash" else attention
             pcfg = pl.PipelineConfig(num_stages=1, num_microbatches=1,
-                                     remat=remat, loss_chunks=loss_chunks)
+                                     remat=remat, loss_chunks=loss_chunks,
+                                     kernel_ce=kernel_ce,
+                                     kernel_prologue=kernel_prologue)
             if offload:
                 from llama_pipeline_parallel_tpu.optim.offload import (
                     HostOffloadAdamW,
@@ -530,6 +533,83 @@ def main() -> None:
                                 round(transfer_s / dts[False], 3)}}
             except Exception as e:
                 print(f"bench offload rows failed: {e!r}", file=sys.stderr,
+                      flush=True)
+
+        # Pallas kernel rows (BENCH_KERNELS=0 skips): the fused CE head and
+        # the fused rms_norm->RoPE->QKV prologue (`kernels.*`,
+        # docs/KERNELS.md) against their XLA twins at the same shape, each
+        # row carrying the MODELED bytes the kernel keeps in VMEM next to
+        # the measured step-time delta and the implied bandwidth — so the
+        # win is measured, not asserted (on CPU the kernels run in
+        # interpret mode: the rows exist, the delta is meaningless and the
+        # twin comparison is the parity smoke). Behind the same fail-fast
+        # probe as everything else.
+        if os.environ.get("BENCH_KERNELS", "1") != "0":
+            try:
+                from llama_pipeline_parallel_tpu.ops.pallas_ce import (
+                    ce_head_traffic_bytes,
+                )
+                from llama_pipeline_parallel_tpu.ops.pallas_prologue import (
+                    prologue_traffic_bytes,
+                )
+
+                gib = 1 << 30
+                tokens = bs_big * seq
+                # the kernel's own VMEM sizing (lane-exact 128-wide vocab
+                # tiles — the XLA-scale 8 would blow VMEM on a real TPU and
+                # the row would silently vanish from the one environment
+                # that matters); twin measured at the SAME chunking
+                ce_chunks = (cfg.vocab_size // 128
+                             if cfg.vocab_size % 128 == 0 else 0)
+
+                def kernel_row(name, dt_kernel, twin, bytes_model):
+                    detail = {
+                        "bytes_model_gib": round(bytes_model / gib, 3),
+                        "interpret": jax.default_backend() != "tpu"}
+                    if twin is not None:
+                        delta = twin["dt"] - dt_kernel
+                        detail["xla_step_ms"] = round(1000 * twin["dt"], 1)
+                        detail["saved_ms"] = round(1000 * delta, 1)
+                        if delta > 0:
+                            # the bandwidth the deleted traffic effectively
+                            # ran at — compare against the chip's HBM spec
+                            detail["achieved_gibps"] = round(
+                                bytes_model / gib / delta, 1)
+                    results[name] = {"dt": dt_kernel,
+                                     "tokens_per_step": tokens,
+                                     "headline": False, "detail": detail}
+
+                dt = (measure(False, "exact", bs_big, loss_chunks=ce_chunks,
+                              kernel_ce=True) if ce_chunks else None)
+                if not ce_chunks:
+                    print(f"bench kernel-ce row skipped: vocab "
+                          f"{cfg.vocab_size} has no 128-wide tiling",
+                          file=sys.stderr, flush=True)
+                if dt is not None:
+                    twin = results.get(
+                        f"remat=0,attn=exact,bs={bs_big},ce=chunk{ce_chunks}")
+                    if twin is None:
+                        twin_dt = measure(False, "exact", bs_big,
+                                          loss_chunks=ce_chunks)
+                        twin = ({"dt": twin_dt} if twin_dt is not None
+                                else None)
+                    kernel_row(f"extra:kernel-ce,bs={bs_big}", dt, twin,
+                               ce_head_traffic_bytes(
+                                   tokens, cfg.hidden_size, cfg.vocab_size,
+                                   ce_chunks))
+
+                dt = measure(False, "exact", bs_big, kernel_prologue=True)
+                if dt is not None:
+                    twin = results.get(f"remat=0,attn=exact,bs={bs_big}")
+                    per_layer = prologue_traffic_bytes(
+                        tokens, cfg.hidden_size,
+                        cfg.num_attention_heads * cfg.head_dim,
+                        cfg.kv_heads * cfg.head_dim,
+                        jnp.dtype(cfg.dtype).itemsize)
+                    kernel_row(f"extra:kernel-prologue,bs={bs_big}", dt, twin,
+                               cfg.num_hidden_layers * per_layer)
+            except Exception as e:
+                print(f"bench kernel rows failed: {e!r}", file=sys.stderr,
                       flush=True)
 
         # Serving microbench (BENCH_SERVING=0 skips): prefill TTFT + steady-

@@ -97,6 +97,7 @@ def decoder_layer(
     cfg: LlamaConfig,
     attn_fn: AttnFn = attention,
     tp_axis: str | None = None,
+    pallas_prologue: bool = False,
 ) -> jnp.ndarray:
     """One transformer block (reference ParallelTransformerLayerPipe,
     models/llama_ds_mp_wrap.py:135-181, which wraps HF LlamaDecoderLayer).
@@ -105,6 +106,12 @@ def decoder_layer(
     qkv/gate/up are column-parallel and wo/down row-parallel, with the
     Megatron f/g operator pair from parallel/tp.py. Head counts are derived
     from the LOCAL weight shards, so the same code runs tp=1 and tp=N.
+
+    `pallas_prologue` (config `kernels.prologue: pallas`) runs
+    rms_norm -> RoPE -> q/k/v as one fused Pallas kernel
+    (ops/pallas_prologue.py) — same numerics within the pinned tolerance,
+    the normed hidden never round-trips HBM; its custom VJP carries the
+    tp_copy psum internally, so both branches compose with tp identically.
     """
     b, s, d = x.shape
     hd = cfg.head_dim
@@ -119,13 +126,20 @@ def decoder_layer(
     kv_local = wk.shape[-1] // hd
 
     residual = x
-    hidden = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
-    if tp_axis is not None:
-        hidden = tp_copy(hidden, tp_axis)
-    q = (hidden @ wq).reshape(b, s, h_local, hd)
-    k = (hidden @ wk).reshape(b, s, kv_local, hd)
-    v = (hidden @ wv).reshape(b, s, kv_local, hd)
-    q, k = apply_rope(q, k, cos, sin)
+    if pallas_prologue:
+        from llama_pipeline_parallel_tpu.ops.pallas_prologue import fused_prologue
+
+        q, k, v = fused_prologue(
+            x, layer["input_norm"], wq, wk, wv, cos, sin,
+            eps=cfg.rms_norm_eps, head_dim=hd, tp_axis=tp_axis)
+    else:
+        hidden = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+        if tp_axis is not None:
+            hidden = tp_copy(hidden, tp_axis)
+        q = (hidden @ wq).reshape(b, s, h_local, hd)
+        k = (hidden @ wk).reshape(b, s, kv_local, hd)
+        v = (hidden @ wv).reshape(b, s, kv_local, hd)
+        q, k = apply_rope(q, k, cos, sin)
     attn_out = attn_fn(q, k, v, padding_mask, causal=True)
     attn_out = attn_out.reshape(b, s, -1) @ layer["attn"]["wo"].astype(dt)
     if tp_axis is not None:
@@ -167,6 +181,7 @@ def run_layers(
     tp_axis: str | None = None,
     remat_policy: str = "nothing_saveable",
     slot_valid: jnp.ndarray | None = None,
+    pallas_prologue: bool = False,
 ) -> jnp.ndarray:
     """Apply a stack of layers (leading axis on every leaf) via lax.scan.
 
@@ -185,7 +200,7 @@ def run_layers(
 
     def compute(layer, h):
         return decoder_layer(layer, h, padding_mask, cos, sin, cfg, attn_fn,
-                             tp_axis=tp_axis)
+                             tp_axis=tp_axis, pallas_prologue=pallas_prologue)
 
     if slot_valid is None:
         def body(h, layer):
@@ -248,6 +263,7 @@ def forward(
     cfg: LlamaConfig,
     attn_fn: AttnFn = attention,
     remat: bool = False,
+    pallas_prologue: bool = False,
 ) -> jnp.ndarray:
     """Single-device full forward: the PP=1 degenerate schedule.
 
@@ -263,7 +279,8 @@ def forward(
         position_ids = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     cos, sin = rope_cos_sin(position_ids, cfg.head_dim, cfg.rope_theta, dtype=cfg.dtype)
     x = embed(params, input_ids, cfg)
-    x = run_layers(params["layers"], x, attention_mask, cos, sin, cfg, attn_fn, remat)
+    x = run_layers(params["layers"], x, attention_mask, cos, sin, cfg, attn_fn,
+                   remat, pallas_prologue=pallas_prologue)
     x = final_norm(params, x, cfg)
     return lm_head(params, x, cfg)
 

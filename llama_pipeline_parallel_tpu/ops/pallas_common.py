@@ -1,0 +1,38 @@
+"""Helpers shared by the Pallas kernel modules (docs/KERNELS.md).
+
+Each kernel module keeps its own `_INTERPRET` global (tests override them
+independently, the flash_attention pattern) and delegates the resolution
+here, so the gating rule and the token-block ladder exist once.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# preferred token-block heights, largest first (8k-aligned for fp32 tiles);
+# the fallback is the full token count (one block)
+TOKEN_BLOCKS = (256, 128, 64, 32, 16, 8)
+
+
+def interpret_mode(override: bool | None) -> bool:
+    """Kernel interpret gating: an explicit module override wins; None ->
+    auto (interpret everywhere but a real TPU backend)."""
+    if override is not None:
+        return override
+    return jax.default_backend() != "tpu"
+
+
+def token_block(n: int, block_tokens: int | None) -> int:
+    """Token-block height for an `[n, ...]` row grid: the caller's pinned
+    value (validated to divide n) or the largest ladder entry dividing n,
+    else n itself (one block)."""
+    if block_tokens is not None:
+        if n % block_tokens:
+            raise ValueError(
+                f"block_tokens={block_tokens} must divide the flattened "
+                f"token count {n}")
+        return block_tokens
+    for cand in TOKEN_BLOCKS:
+        if n % cand == 0:
+            return cand
+    return n

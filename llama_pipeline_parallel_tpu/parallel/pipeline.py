@@ -156,6 +156,22 @@ class PipelineConfig:
     # tp>1 already avoids full logits via the vocab-parallel CE; combining
     # the two is rejected at build time.
     loss_chunks: int = 1
+    # `kernels.ce: pallas` — the loss head runs the fused Pallas kernel
+    # (ops/pallas_ce.py) instead of the XLA vocab-chunked scan: identical
+    # chunking (`loss_chunks` is the vocab tile count; 1 = whole vocab per
+    # tile), bit-equal loss, but the per-chunk fp32 logits block and the
+    # backward's fp32 dh accumulator stay in VMEM instead of round-tripping
+    # HBM (loss_head_bytes models the difference for preflight). tp>1 is
+    # rejected like loss_chunks>1 — the vocab-parallel CE already owns that
+    # regime.
+    kernel_ce: bool = False
+    # `kernels.prologue: pallas` — every decoder layer's
+    # rms_norm -> RoPE -> q/k/v prologue runs as one fused Pallas kernel
+    # (ops/pallas_prologue.py, custom VJP; composes with tp — the tp_copy
+    # psum moves inside the op's backward). Parity within the pinned
+    # tolerance of docs/KERNELS.md; holds each projection's LOCAL weight
+    # shard VMEM-resident, so it targets tp-sharded layers or small models.
+    kernel_prologue: bool = False
     # Batches carry PACKING segment ids in `attention_mask` (the packed
     # collator's contract, data/collator.py): under sp the ring strategy then
     # rotates the kv segment slab with its k/v so packed examples never
@@ -376,6 +392,38 @@ def host_stash_bytes(pcfg: PipelineConfig, mb_rows: int, local_seqlen: int,
         total += activation_ring_bytes(pcfg, mb_rows, local_seqlen,
                                        hidden_size, dtype_bytes) + slot
     return total
+
+
+def loss_head_bytes(pcfg: PipelineConfig, mb_rows: int, local_seqlen: int,
+                    hidden_size: int, vocab_size: int) -> int:
+    """Live per-device bytes of the LAST stage's loss head — the term
+    tools/preflight.py adds to its memory model and lets --select score as
+    the ce axis. XLA path: one fp32 [tokens, V/loss_chunks] logits block
+    (the whole [tokens, V] at loss_chunks=1) plus, when chunked, the
+    backward scan's fp32 [tokens, hidden] dh accumulator. Pallas path
+    (`kernels.ce: pallas`): ~0 — the logits tile and the dh accumulator
+    live in VMEM scratch; only [tokens]-sized statistics reach HBM
+    (ops/pallas_ce.py)."""
+    tokens = mb_rows * local_seqlen
+    if pcfg.kernel_ce:
+        return 0
+    logits_block = tokens * (vocab_size // max(pcfg.loss_chunks, 1)) * 4
+    dh_acc = tokens * hidden_size * 4 if pcfg.loss_chunks > 1 else 0
+    return logits_block + dh_acc
+
+
+def _head_ce_sum_count(pcfg: PipelineConfig):
+    """The fused lm-head+CE op the cond-gated head branches call — the XLA
+    vocab-chunked scan (ops/cross_entropy.py) or its Pallas promotion
+    (ops/pallas_ce.py) under `kernels.ce: pallas`. One resolution point so
+    the three schedules' heads cannot drift."""
+    if pcfg.kernel_ce:
+        from llama_pipeline_parallel_tpu.ops.pallas_ce import pallas_ce_sum_count
+
+        return lambda h, w, t: pallas_ce_sum_count(h, w, t, pcfg.loss_chunks)
+    from llama_pipeline_parallel_tpu.ops.cross_entropy import fused_ce_sum_count
+
+    return lambda h, w, t: fused_ce_sum_count(h, w, t, pcfg.loss_chunks)
 
 
 # ---------------------------------------------------------------------------
@@ -882,11 +930,9 @@ def _pipeline_loss_local(
 
         def head(h_, targets_):
             hn = llama.final_norm(params, h_, cfg)
-            if pcfg.loss_chunks > 1:
-                from llama_pipeline_parallel_tpu.ops.cross_entropy import fused_ce_sum_count
-
-                return fused_ce_sum_count(hn, params["lm_head"].astype(cfg.dtype),
-                                          targets_, pcfg.loss_chunks)
+            if pcfg.loss_chunks > 1 or pcfg.kernel_ce:
+                return _head_ce_sum_count(pcfg)(
+                    hn, params["lm_head"].astype(cfg.dtype), targets_)
             logits = llama.lm_head(params, hn, cfg)
             return llama.token_loss_sum_and_count_preshifted(logits, targets_)
 
@@ -920,7 +966,8 @@ def _pipeline_loss_local(
                              remat_policy=pcfg.remat_policy,
                              slot_valid=_slot_valid(pcfg, stage, tp_size,
                                                     sp_size, k_max)
-                             if v == 1 else None)
+                             if v == 1 else None,
+                             pallas_prologue=pcfg.kernel_prologue)
 
         # The last stage's finished microbatch contributes its loss in-tick
         # (nothing is collected into an M-sized buffer; the head itself is
@@ -1039,7 +1086,8 @@ def _pipeline_1f1b_local(
                              remat=pcfg.remat, tp_axis=tp_axis,
                              remat_policy=pcfg.remat_policy,
                              slot_valid=_slot_valid(pcfg, stage, tp_size,
-                                                    sp_size, k_max))
+                                                    sp_size, k_max),
+                             pallas_prologue=pcfg.kernel_prologue)
         if not with_loss:
             return y
 
@@ -1057,11 +1105,9 @@ def _pipeline_1f1b_local(
         else:
             def head_branch(norm_w, head_w, y_):
                 h = llama.final_norm({"norm": norm_w}, y_, cfg)
-                if pcfg.loss_chunks > 1:
-                    from llama_pipeline_parallel_tpu.ops.cross_entropy import fused_ce_sum_count
-
-                    return fused_ce_sum_count(h, head_w.astype(cfg.dtype),
-                                              targets, pcfg.loss_chunks)[0]
+                if pcfg.loss_chunks > 1 or pcfg.kernel_ce:
+                    return _head_ce_sum_count(pcfg)(
+                        h, head_w.astype(cfg.dtype), targets)[0]
                 logits = llama.lm_head({"lm_head": head_w}, h, cfg)
                 return llama.token_loss_sum_and_count_preshifted(logits, targets)[0]
 
@@ -1272,7 +1318,8 @@ def _pipeline_interleaved_1f1b_local(
                 p["layers"])
         y = llama.run_layers(chunk_layers, x0, pad, cos, sin, cfg,
                              attn_fn=attn_fn, remat=pcfg.remat,
-                             tp_axis=tp_axis, remat_policy=pcfg.remat_policy)
+                             tp_axis=tp_axis, remat_policy=pcfg.remat_policy,
+                             pallas_prologue=pcfg.kernel_prologue)
         if not with_loss:
             return y
 
@@ -1289,11 +1336,9 @@ def _pipeline_interleaved_1f1b_local(
         else:
             def head_branch(norm_w, head_w, y_):
                 h = llama.final_norm({"norm": norm_w}, y_, cfg)
-                if pcfg.loss_chunks > 1:
-                    from llama_pipeline_parallel_tpu.ops.cross_entropy import fused_ce_sum_count
-
-                    return fused_ce_sum_count(h, head_w.astype(cfg.dtype),
-                                              targets, pcfg.loss_chunks)[0]
+                if pcfg.loss_chunks > 1 or pcfg.kernel_ce:
+                    return _head_ce_sum_count(pcfg)(
+                        h, head_w.astype(cfg.dtype), targets)[0]
                 logits = llama.lm_head({"lm_head": head_w}, h, cfg)
                 return llama.token_loss_sum_and_count_preshifted(logits, targets)[0]
 
@@ -1759,6 +1804,45 @@ def make_pipeline_loss_and_grad(
             raise ValueError(
                 f"loss_chunks={pcfg.loss_chunks} must divide "
                 f"vocab_size={cfg.vocab_size}")
+    if pcfg.kernel_ce and tp > 1:
+        raise ValueError(
+            "kernels.ce=pallas is redundant under tp > 1: the "
+            "vocab-parallel CE already never materializes full logits "
+            "(shard the head wider instead)")
+    if pcfg.kernel_ce and jax.default_backend() == "tpu":
+        # The binding VMEM term is the backward dW kernel's fp32
+        # [d, V/loss_chunks] scratch (4 B/elem regardless of the compute
+        # dtype; the fwd/dh kernels' weight blocks are smaller). Refuse at
+        # build time — with the actionable knob — instead of dying deep
+        # inside a Mosaic allocation failure. Interpret mode (every other
+        # backend) has no such limit, which is why this cannot live in
+        # PipelineConfig.__post_init__.
+        tile = cfg.hidden_size * (cfg.vocab_size // pcfg.loss_chunks) * 4
+        if tile > 16 * (1 << 20):
+            raise ValueError(
+                f"kernels.ce=pallas needs its fp32 [hidden, "
+                f"vocab/loss_chunks] dW scratch to fit VMEM: "
+                f"[{cfg.hidden_size}, "
+                f"{cfg.vocab_size // pcfg.loss_chunks}] is "
+                f"{tile / (1 << 20):.0f} MiB against ~16 MiB — raise "
+                f"loss_vocab_chunks (128-wide tiles: "
+                f"loss_vocab_chunks={max(cfg.vocab_size // 128, 1)}) or "
+                f"fall back to kernels.ce=xla (docs/KERNELS.md)")
+    if pcfg.kernel_prologue and jax.default_backend() == "tpu":
+        # Same build-time posture for the prologue: its backward holds the
+        # three fp32 [d, width_local] dW scratches (plus the dtype-width
+        # weight blocks) VMEM-resident at once, and the kernel has no
+        # chunking knob — the remedies are tp-sharding the projections or
+        # the XLA path (docs/KERNELS.md "when to prefer the XLA path").
+        widths = (cfg.hidden_size + 2 * cfg.kv_heads * cfg.head_dim) // tp
+        scratch = cfg.hidden_size * widths * 4
+        if scratch > 16 * (1 << 20):
+            raise ValueError(
+                f"kernels.prologue=pallas holds ~{scratch / (1 << 20):.0f} "
+                f"MiB of fp32 dW scratch ([{cfg.hidden_size}] rows x "
+                f"{widths} local q+k+v columns) against ~16 MiB VMEM — "
+                f"shard the projections wider (tp) or fall back to "
+                f"kernels.prologue=xla (docs/KERNELS.md)")
     if tp > 1:
         if cfg.kv_heads % tp or cfg.num_attention_heads % tp:
             raise ValueError(

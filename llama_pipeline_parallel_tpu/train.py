@@ -132,6 +132,32 @@ def _offload_flags(cfg: dict) -> tuple[bool, bool]:
             bool(node.get("activations", False)))
 
 
+def _kernel_flags(cfg: dict) -> tuple[bool, bool]:
+    """The `kernels.*` config block (fused Pallas TPU kernels,
+    docs/KERNELS.md), parsed in one place so trainer + preflight agree:
+    `ce` selects the loss head's backend, `prologue` the decoder layers'
+    rms_norm->RoPE->QKV prologue. Values are `xla` (default) or `pallas`;
+    unknown keys/values are rejected like `offload.*`."""
+    node = cfg.get("kernels") or {}
+    if not isinstance(node, dict):
+        raise ValueError(
+            f"kernels must be a mapping of op backends, e.g. "
+            f"kernels: {{ce: pallas}} — got {node!r}")
+    known = {"ce", "prologue"}
+    unknown = set(node) - known
+    if unknown:
+        raise ValueError(f"unknown kernels.* key(s) {sorted(unknown)}; "
+                         f"known: {sorted(known)}")
+    flags = []
+    for key in ("ce", "prologue"):
+        val = node.get(key, "xla")
+        if val not in ("xla", "pallas"):
+            raise ValueError(f"kernels.{key} must be 'xla' or 'pallas', "
+                             f"got {val!r}")
+        flags.append(val == "pallas")
+    return tuple(flags)
+
+
 def _offload_static(pcfg: "pl.PipelineConfig", mb_rows: int,
                     local_seqlen: int, hidden_size: int,
                     dtype_bytes: int) -> dict:
@@ -211,6 +237,7 @@ def build_pipeline_config(cfg: dict, mesh_cfg: Any, manifest: StageManifest
     """PipelineConfig from the run config — one construction for the trainer
     and tools/preflight.py."""
     offload_wgrad, offload_acts = _offload_flags(cfg)
+    kernel_ce, kernel_prologue = _kernel_flags(cfg)
     return pl.PipelineConfig(
         num_stages=mesh_cfg.pp,
         num_microbatches=cfg.get("gradient_accumulation_steps", 1),
@@ -224,7 +251,9 @@ def build_pipeline_config(cfg: dict, mesh_cfg: Any, manifest: StageManifest
         layer_counts=None if manifest.is_even else manifest.stage_layer_counts,
         packed=_packing_factor(cfg) > 1,
         offload_wgrad=offload_wgrad,
-        offload_activations=offload_acts)
+        offload_activations=offload_acts,
+        kernel_ce=kernel_ce,
+        kernel_prologue=kernel_prologue)
 
 
 def build_dataset_and_collator(cfg: dict, model_cfg: LlamaConfig) -> tuple[Any, Any]:
@@ -610,6 +639,13 @@ def _run_training(cfg: dict) -> dict:
             "transfers gated off (no distinct host memory space on this "
             "backend, or LPT_HOST_STASH_FORCE=0) — same schedule, stores "
             "stay device-resident")
+    if pcfg.kernel_ce or pcfg.kernel_prologue:
+        logger.info(
+            "pallas kernels enabled (ce=%s prologue=%s): %s (docs/KERNELS.md)",
+            pcfg.kernel_ce, pcfg.kernel_prologue,
+            "Mosaic-compiled" if jax.default_backend() == "tpu"
+            else "interpret mode — parity semantics, no kernel speedup "
+                 "off-TPU")
     topology = _topology_meta(mesh, pcfg)
     # Numerics observatory (docs/OBSERVABILITY.md "Numerics"): per-stage
     # training-dynamics stats computed in-graph, anomaly detection + the

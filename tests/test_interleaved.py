@@ -77,8 +77,10 @@ def assert_tree_bitexact(a, b):
 
 @pytest.mark.parametrize("pp,v,microbatches", [
     (2, 2, 4),                  # the dryrun_multichip acceptance grid
-    (4, 2, 4),
-    (2, 4, 4),                  # deeper interleaving, v=4
+    # one fast representative is enough for the tier-1 budget (PR 10
+    # rebalance); the deeper rings / v=4 / bigger-M rows are round-gate
+    pytest.param(4, 2, 4, marks=pytest.mark.slow),
+    pytest.param(2, 4, 4, marks=pytest.mark.slow),   # deeper interleaving
     pytest.param(2, 2, 8, marks=pytest.mark.slow),
     pytest.param(4, 2, 8, marks=pytest.mark.slow),
 ])
@@ -98,7 +100,8 @@ def test_interleaved_matches_flat_bitexact(cfg, params, devices, pp, v,
 
 
 @pytest.mark.parametrize("dp,tp,sp,chunks", [
-    (2, 1, 1, 1), (1, 2, 1, 1),
+    (2, 1, 1, 1),               # one fast hybrid rep (PR 10 rebalance)
+    pytest.param(1, 2, 1, 1, marks=pytest.mark.slow),
     pytest.param(1, 1, 2, 1, marks=pytest.mark.slow),
     pytest.param(1, 1, 1, 2, marks=pytest.mark.slow),
 ])
@@ -139,7 +142,7 @@ def test_interleaved_matches_single_device_reference(cfg, params, devices):
 @pytest.mark.parametrize("pp,microbatches", [
     (2, 4),
     (4, 2),   # M < S: the pipe never fills — pure warmup+drain masking
-    (4, 1),   # M == 1
+    pytest.param(4, 1, marks=pytest.mark.slow),   # M == 1 (sub-case of M<S)
 ])
 def test_interleaved_v1_degenerates_to_flat(cfg, params, devices, pp,
                                             microbatches):
@@ -476,11 +479,14 @@ def test_trainer_interleaved_end_to_end(tmp_path, devices):
     assert per_chunk.shape == (2, 2) and np.all(per_chunk > 0)
 
 
+@pytest.mark.slow
 def test_trainer_interleaved_offload_zero2(tmp_path, devices):
     """The 65B run-of-record combination (conf/llama_65b_pp8_v2_tp2_dp2.yaml):
     interleaved 1F1B under the ZeRO-2 host-offloaded optimizer — the
     [S, v, k, ...] layout must stream through host masters/moments, the
-    dp-sharded grad outputs, and the numerics stats dispatch."""
+    dp-sharded grad outputs, and the numerics stats dispatch. Slow-marked
+    (PR 10 rebalance): the plain interleaved trainer e2e stays fast, and
+    test_trainer/test_offload keep the zero2 machinery's own fast gates."""
     from llama_pipeline_parallel_tpu.train import run_training
 
     summary = run_training({

@@ -145,6 +145,8 @@ def test_step_stats_shapes_and_values(step_setup):
     assert layers_norm <= float(metrics["grad_norm"]) + 1e-4
 
 
+@pytest.mark.slow  # PR 10 rebalance: the 1f1b stats test is the fast gate;
+# gpipe folds the same value_and_grad aux path
 def test_gpipe_schedule_collects_stats_too(step_setup):
     cfg, mesh, manifest, pcfg, tx, schedule, params, state, batch = step_setup
     import dataclasses
@@ -413,10 +415,14 @@ def test_grad_nonfinite_stage_out_of_range_rejected(tmp_path, devices):
         run_training(cfg)
 
 
+@pytest.mark.slow
 def test_chaos_grad_nonfinite_offload_path(tmp_path, devices):
     """The host-offload optimizer path: the poison forces the separate
     stats dispatch, the nonfinite global norm skips the masters update
-    (HostOffloadAdamW.skip_nonfinite), and the stream records it."""
+    (HostOffloadAdamW.skip_nonfinite), and the stream records it.
+    Slow-marked (PR 10 rebalance): the fused-path chaos e2e stays the fast
+    detect/skip/localize gate; this re-runs it through the offload
+    optimizer only."""
     from llama_pipeline_parallel_tpu.train import run_training
 
     cfg = _tiny_cfg(

@@ -1,0 +1,302 @@
+"""Pallas fused RMSNorm->RoPE->QKV prologue (ops/pallas_prologue.py).
+
+Parity gate vs the composed ops/rmsnorm.py -> matmul -> ops/rope.py
+reference (the exact sequence models/llama/model.py's decoder_layer runs):
+bf16 forward bit-equal, fp32 within the pinned ~1-ulp tolerance (one
+blocked-vs-unblocked matmul rounding); grads within pinned tolerances,
+including GQA head layouts and tp-sharded weights (the tp_copy psum moves
+inside the op's custom VJP); a jaxpr assertion pinning the kernel in-graph
+under `kernels.prologue: pallas`; and pipeline-level on-vs-off parity
+across the schedule grid (the zb1 W-replay differentiates the kernel
+w.r.t. params only)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llama_pipeline_parallel_tpu.models.llama import model as llama
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+from llama_pipeline_parallel_tpu.ops.pallas_prologue import (
+    fused_prologue,
+    prologue_traffic_bytes,
+)
+from llama_pipeline_parallel_tpu.ops.rmsnorm import rms_norm
+from llama_pipeline_parallel_tpu.ops.rope import apply_rope, rope_cos_sin
+from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
+
+EPS = 1e-6
+# fp32: a single blocked-vs-unblocked matmul rounding (~1 ulp of the
+# activations); bf16 forward is bit-equal, its grads differ only where the
+# custom VJP's fp32 dhidden rounds once vs the reference's bf16 chain
+FP32_ATOL = 1e-5
+BF16_GRAD_RTOL = 0.05
+
+
+def _shapes(d=32, hd=8, h=4, kvh=2):
+    return d, hd, h, kvh
+
+
+def _inputs(b=2, s=8, d=32, hd=8, h=4, kvh=2, dtype=jnp.float32, seed=0):
+    r = np.random.RandomState(seed)
+    x = jnp.asarray(r.randn(b, s, d).astype(np.float32), dtype)
+    nw = jnp.asarray(1.0 + 0.1 * r.randn(d).astype(np.float32), dtype)
+    wq = jnp.asarray((r.randn(d, h * hd) * 0.05).astype(np.float32), dtype)
+    wk = jnp.asarray((r.randn(d, kvh * hd) * 0.05).astype(np.float32), dtype)
+    wv = jnp.asarray((r.randn(d, kvh * hd) * 0.05).astype(np.float32), dtype)
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    cos, sin = rope_cos_sin(pos, hd, dtype=dtype)
+    return x, nw, wq, wk, wv, cos, sin
+
+
+def reference(x, nw, wq, wk, wv, cos, sin, hd):
+    """The exact decoder_layer prologue sequence."""
+    b, s, _ = x.shape
+    hidden = rms_norm(x, nw, EPS)
+    q = (hidden @ wq).reshape(b, s, wq.shape[-1] // hd, hd)
+    k = (hidden @ wk).reshape(b, s, wk.shape[-1] // hd, hd)
+    v = (hidden @ wv).reshape(b, s, wv.shape[-1] // hd, hd)
+    q, k = apply_rope(q, k, cos, sin)
+    return q, k, v
+
+
+# ---------------------------------------------------------------------------
+# Op-level parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("h,kvh", [(4, 4), (4, 2), (8, 1)])  # MHA, GQA, MQA
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_forward_parity(dtype, h, kvh):
+    x, nw, wq, wk, wv, cos, sin = _inputs(h=h, kvh=kvh, dtype=dtype)
+    want = reference(x, nw, wq, wk, wv, cos, sin, 8)
+    got = fused_prologue(x, nw, wq, wk, wv, cos, sin, eps=EPS, head_dim=8)
+    for name, a, b in zip("qkv", got, want):
+        assert a.shape == b.shape and a.dtype == b.dtype
+        if dtype == jnp.bfloat16:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)
+        else:
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=FP32_ATOL, rtol=1e-6,
+                                       err_msg=name)
+
+
+@pytest.mark.parametrize("h,kvh", [(4, 2)])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_grad_parity(dtype, h, kvh):
+    x, nw, wq, wk, wv, cos, sin = _inputs(h=h, kvh=kvh, dtype=dtype)
+
+    def scalar(fn):
+        def run(x_, nw_, wq_, wk_, wv_):
+            q, k, v = fn(x_, nw_, wq_, wk_, wv_)
+            return (jnp.sum(q.astype(jnp.float32) ** 2)
+                    + jnp.sum((k.astype(jnp.float32) * 1.3) ** 2)
+                    + jnp.sum(v.astype(jnp.float32) ** 3))
+        return run
+
+    ref_fn = scalar(lambda *a: reference(*a, cos, sin, 8))
+    got_fn = scalar(lambda *a: fused_prologue(*a, cos, sin, eps=EPS,
+                                              head_dim=8))
+    dref = jax.grad(ref_fn, argnums=(0, 1, 2, 3, 4))(x, nw, wq, wk, wv)
+    dgot = jax.grad(got_fn, argnums=(0, 1, 2, 3, 4))(x, nw, wq, wk, wv)
+    for name, a, b in zip(("dx", "dnorm", "dwq", "dwk", "dwv"), dgot, dref):
+        a32, b32 = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        if dtype == jnp.bfloat16:
+            scale = max(1e-6, float(np.abs(b32).max()))
+            assert np.abs(a32 - b32).max() / scale < BF16_GRAD_RTOL, name
+        else:
+            np.testing.assert_allclose(a32, b32, atol=2e-5, rtol=1e-5,
+                                       err_msg=name)
+
+
+def test_cos_sin_cotangents_are_zero():
+    """cos/sin are positional data: the op pins their cotangents to zero
+    (nothing in the pipeline differentiates them — a nonzero value would
+    only ever feed dead code)."""
+    x, nw, wq, wk, wv, cos, sin = _inputs()
+    g = jax.grad(lambda c: jnp.sum(fused_prologue(
+        x, nw, wq, wk, wv, c, sin, eps=EPS, head_dim=8)[0]
+        .astype(jnp.float32) ** 2))(cos)
+    assert float(jnp.abs(g).sum()) == 0.0
+
+
+def test_validation_errors():
+    x, nw, wq, wk, wv, cos, sin = _inputs()
+    with pytest.raises(ValueError, match="multiples of head_dim"):
+        fused_prologue(x, nw, wq[:, :-1], wk, wv, cos, sin, eps=EPS,
+                       head_dim=8)
+    with pytest.raises(ValueError, match="must be even"):
+        fused_prologue(x, nw, wq, wk, wv, cos, sin, eps=EPS, head_dim=1)
+    with pytest.raises(ValueError, match="must match"):
+        fused_prologue(x, nw, wq, wk, wv[:, :8], cos, sin, eps=EPS,
+                       head_dim=8)
+
+
+def test_traffic_model_arithmetic():
+    # fwd+bwd: 2 x (hidden write + 3 reads) + 2 x (pre-rope q/k round trip)
+    assert prologue_traffic_bytes(64, 32, 32, 16, 2) == \
+        2 * 4 * 64 * 32 * 2 + 2 * 2 * 64 * (32 + 16) * 2
+
+
+def test_lowering_kernel_in_graph():
+    """Structural pin: the fwd+bwd jaxpr holds the forward kernel plus the
+    flash-style split backward (dhidden + dW) as pallas_call equations, so
+    the zb1 B unit can DCE the dW kernel and the W replay the dhidden one."""
+    x, nw, wq, wk, wv, cos, sin = _inputs()
+
+    def loss(x_, nw_, wq_):
+        q, k, v = fused_prologue(x_, nw_, wq_, wk, wv, cos, sin, eps=EPS,
+                                 head_dim=8)
+        return jnp.sum(q.astype(jnp.float32) ** 2) + \
+            jnp.sum(k.astype(jnp.float32) ** 2)
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(x, nw, wq)
+    text = str(jaxpr)
+    assert text.count("pallas_call") >= 3
+
+
+# ---------------------------------------------------------------------------
+# Pipeline integration: kernels.prologue across schedules, tp, eval
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cfg():
+    return LlamaConfig.tiny(num_hidden_layers=8)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return llama.init_params(jax.random.PRNGKey(0), cfg)
+
+
+def make_batch(cfg, batch_size=8, seqlen=16, seed=42):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(3, cfg.vocab_size, size=(batch_size, seqlen)).astype(np.int32)
+    mask = np.ones((batch_size, seqlen), np.int32)
+    mask[:, -3:] = 0
+    labels = ids.copy()
+    labels[mask == 0] = llama.IGNORE_INDEX
+    return {
+        "input_ids": jnp.asarray(ids),
+        "attention_mask": jnp.asarray(mask),
+        "position_ids": jnp.asarray(np.broadcast_to(
+            np.arange(seqlen, dtype=np.int32), (batch_size, seqlen)).copy()),
+        "labels": jnp.asarray(labels),
+    }
+
+
+def run_pipeline(params, batch, cfg, pp=2, schedule="1f1b", v=1, tp=1,
+                 microbatches=4, **pkw):
+    mesh = make_mesh(MeshConfig(pp=pp, tp=tp))
+    manifest = StageManifest.for_config(cfg, pp, virtual_stages=v)
+    stacked = pl.stack_stages(params, manifest)
+    pcfg = pl.PipelineConfig(num_stages=pp, num_microbatches=microbatches,
+                             schedule=schedule, virtual_stages=v, **pkw)
+    fn = jax.jit(pl.make_pipeline_loss_and_grad(mesh, cfg, pcfg, stacked))
+    loss, grads = fn(stacked, batch)
+    return float(loss), pl.unstack_stages(grads, manifest)
+
+
+def assert_grads_close(a, b, atol=5e-7):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=2e-5, atol=atol)
+
+
+# Fast lane: flat + the zb1 split backward (W replay differentiates the
+# kernel w.r.t. params only); interleaved + offload rows slow-marked.
+@pytest.mark.parametrize("schedule,v,offload", [
+    ("1f1b", 1, {}),
+    ("zb1", 2, {}),
+    pytest.param("interleaved_1f1b", 2, {}, marks=pytest.mark.slow),
+    pytest.param("zb1", 2, {"offload_wgrad": True,
+                            "offload_activations": True},
+                 marks=pytest.mark.slow),
+])
+def test_pipeline_prologue_on_vs_off(cfg, params, devices, schedule, v,
+                                     offload):
+    batch = make_batch(cfg)
+    l_off, g_off = run_pipeline(params, batch, cfg, schedule=schedule, v=v,
+                                **offload)
+    l_on, g_on = run_pipeline(params, batch, cfg, schedule=schedule, v=v,
+                              kernel_prologue=True, **offload)
+    np.testing.assert_allclose(l_on, l_off, rtol=1e-6)
+    assert_grads_close(g_on, g_off)
+
+
+def test_pipeline_prologue_under_tp(cfg, params, devices):
+    """tp=2: the fused op's in-VJP psum must reproduce the tp_copy
+    backward — norm/embedding grads are full tp sums, not 1/tp of them."""
+    batch = make_batch(cfg)
+    l_off, g_off = run_pipeline(params, batch, cfg, tp=2)
+    l_on, g_on = run_pipeline(params, batch, cfg, tp=2, kernel_prologue=True)
+    np.testing.assert_allclose(l_on, l_off, rtol=1e-6)
+    assert_grads_close(g_on, g_off, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_pipeline_both_kernels_zb1(cfg, params, devices):
+    """The full `kernels: {ce: pallas, prologue: pallas}` config under the
+    zb1 split backward — the PR's two tentpole kernels composed."""
+    batch = make_batch(cfg)
+    l_off, g_off = run_pipeline(params, batch, cfg, schedule="zb1", v=2,
+                                loss_chunks=4)
+    l_on, g_on = run_pipeline(params, batch, cfg, schedule="zb1", v=2,
+                              loss_chunks=4, kernel_ce=True,
+                              kernel_prologue=True)
+    assert l_on == l_off  # the CE contract holds with the prologue fused too
+    assert_grads_close(g_on, g_off)
+
+
+def test_single_device_forward_parity(cfg, params):
+    """model.forward's pallas_prologue flag: logits parity on the PP=1
+    degenerate path (the decode/serve stack shares decoder_layer)."""
+    batch = make_batch(cfg, batch_size=2)
+    base = llama.forward(params, batch["input_ids"], batch["attention_mask"],
+                         cfg=cfg)
+    fused = llama.forward(params, batch["input_ids"], batch["attention_mask"],
+                          cfg=cfg, pallas_prologue=True)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(base),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_kernel_prologue_vmem_scratch_check_on_tpu_backend(cfg, params,
+                                                           devices,
+                                                           monkeypatch):
+    """On a TPU backend the build refuses an unsharded layer whose fp32
+    q+k+v dW scratches exceed VMEM, naming the tp/xla remedies; tp-sharding
+    the same shape under the guard's arithmetic builds. (Backend faked —
+    interpret mode has no such limit.)"""
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    big = LlamaConfig.tiny(hidden_size=2048, num_attention_heads=32,
+                           num_key_value_heads=32, intermediate_size=64)
+    mesh = make_mesh(MeshConfig(pp=2))
+    manifest = StageManifest.for_config(big, 2)
+    stacked = jax.eval_shape(
+        lambda r: pl.stack_stages(llama.init_params(r, big), manifest),
+        jax.random.PRNGKey(0))
+    pcfg = pl.PipelineConfig(num_stages=2, num_microbatches=2,
+                             kernel_prologue=True)
+    # 2048 rows x 3*2048 local columns x 4 B = 48 MiB of dW scratch
+    with pytest.raises(ValueError, match="kernels.prologue=xla"):
+        pl.make_pipeline_loss_and_grad(mesh, big, pcfg, stacked)
+    mesh_tp = make_mesh(MeshConfig(pp=2, tp=4))
+    stacked_tp = stacked  # spec construction only; shapes unchanged
+    pl.make_pipeline_loss_and_grad(mesh_tp, big, pcfg, stacked_tp)  # builds
+
+
+def test_pipeline_jaxpr_has_kernel_only_when_on(cfg, params, devices):
+    mesh = make_mesh(MeshConfig(pp=2))
+    manifest = StageManifest.for_config(cfg, 2)
+    stacked = pl.stack_stages(params, manifest)
+    batch = make_batch(cfg, batch_size=2)
+    texts = {}
+    for on in (False, True):
+        pcfg = pl.PipelineConfig(num_stages=2, num_microbatches=2,
+                                 kernel_prologue=on)
+        fn = pl.make_pipeline_loss_and_grad(mesh, cfg, pcfg, stacked)
+        texts[on] = str(jax.make_jaxpr(fn)(stacked, batch))
+    assert "pallas_call" in texts[True]
+    assert "pallas_call" not in texts[False]

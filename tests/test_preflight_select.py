@@ -123,3 +123,89 @@ def test_select_overrides_roundtrip():
     assert "virtual_stages=2" in line
     assert "offload.wgrad_stash=true" in line
     assert "offload.activations" not in line
+    # the legacy grid carries no ce axis: no kernel overrides appear
+    assert "kernels.ce" not in line and "loss_vocab_chunks" not in line
+
+
+# ---------------------------------------------------------------------------
+# The ce axis (PR 10): loss_chunks / kernels.ce as selection candidates
+# ---------------------------------------------------------------------------
+
+VOCAB = 32000
+# the real constructor's output at this shape: as-written dense, 8-way
+# chunked XLA, and the ONE VMEM-sized pallas option (128-wide tiles)
+CE_AXIS = ((1, False), (8, False), (250, True))
+
+
+def pick_ce(base_gib, hbm, bw):
+    return preflight.select_schedule(
+        preflight.enumerate_candidates(S, M, LAYERS, ce_options=CE_AXIS),
+        base_gib, DIMS, hbm, bw, COMPUTE, vocab=VOCAB)
+
+
+def test_ce_axis_expands_grid_and_scores_loss_head():
+    """Each (loss_chunks, kernel_ce) option appears per schedule point, and
+    the scored rows carry the loss-head term: dense XLA = the fp32
+    [tokens, V] block (4096 tokens x 32000 x 4B = 0.49 GiB at the 65B pp8
+    shape), chunked-8 = block/8 + the fp32 dh accumulator, pallas = 0."""
+    rows = pick_ce(base_gib=70.0, hbm=1000.0, bw=30.0)[1]
+    combos = {(r["loss_chunks"], r["kernel_ce"]) for r in rows}
+    assert combos == set(CE_AXIS)
+    zb1_v2 = [r for r in rows if r["schedule"] == "zb1"
+              and r["virtual_stages"] == 2 and r["accum_chunks"] == 1
+              and not r["offload_wgrad"] and not r["offload_activations"]]
+    by_ce = {(r["loss_chunks"], r["kernel_ce"]): r for r in zb1_v2}
+    tokens = 8 * 512
+    assert by_ce[(1, False)]["loss_head_gib"] == pytest.approx(
+        tokens * VOCAB * 4 / (1 << 30), abs=0.01)
+    assert by_ce[(8, False)]["loss_head_gib"] == pytest.approx(
+        (tokens * VOCAB // 8 * 4 + tokens * 8192 * 4) / (1 << 30), abs=0.01)
+    assert by_ce[(250, True)]["loss_head_gib"] == 0.0
+    # est_peak orders pallas < chunked-xla < dense-xla at fixed schedule
+    assert by_ce[(250, True)]["est_peak_gib"] \
+        < by_ce[(8, False)]["est_peak_gib"] \
+        < by_ce[(1, False)]["est_peak_gib"]
+
+
+def test_ce_axis_winner_takes_the_zero_byte_head():
+    """At the same bubble/host point the tie-break resolves through
+    est_peak, so the Pallas head (the only option with a zero loss-head
+    term) wins the axis; the overrides line names both knobs."""
+    winner, _ = pick_ce(base_gib=70.0, hbm=1000.0, bw=30.0)
+    assert winner["kernel_ce"] and winner["loss_chunks"] == 250
+    assert winner["schedule"] == "zb1"  # the schedule choice is unchanged
+    line = preflight.select_overrides(winner)
+    assert "kernels.ce=pallas" in line and "loss_vocab_chunks=250" in line
+
+
+def test_ce_axis_options_shape():
+    """The axis constructor _print_selection uses: tp>1 suppresses the axis
+    entirely (the trainer rejects loss_chunks/kernels.ce there — selection
+    must never emit overrides the launch line refuses), and the Pallas
+    head is offered CHUNKED only (loss_chunks=1 would hold the whole
+    [d, V] weight as one VMEM block)."""
+    assert preflight.ce_axis_options(1, VOCAB, tp=2) is None
+    axis = preflight.ce_axis_options(1, VOCAB, tp=1)
+    assert axis == CE_AXIS
+    # the pallas option exists ONLY at the kernel's VMEM sizing — never at
+    # the XLA-scale chunk counts, never unchunked
+    assert all(chunks == 250 for chunks, k in axis if k)
+    # as-written chunking is kept as its own option alongside the 8-way
+    assert preflight.ce_axis_options(16, VOCAB, tp=1) == (
+        (8, False), (16, False), (250, True))
+    # vocab with no 128-wide tiling: no pallas option at all
+    assert preflight.ce_axis_options(1, VOCAB + 8, tp=1) == (
+        (1, False), (8, False))
+
+
+def test_ce_axis_rescues_a_budget_the_xla_head_blows():
+    """A budget sized between the pallas and XLA loss-head terms: only the
+    kernels.ce=pallas rows fit, selection says so analytically."""
+    # flat S=8 ring = 15 slots x 64 MiB = 0.94 GiB: base 94.0 leaves room
+    # for ring + the zero-byte pallas head but not ring + 0.49 GiB dense
+    rows = pick_ce(base_gib=94.0, hbm=95.0, bw=30.0)[1]
+    flat = [r for r in rows if r["schedule"] == "1f1b"
+            and r["accum_chunks"] == 1 and not r["offload_activations"]]
+    verdict = {(r["loss_chunks"], r["kernel_ce"]): r["feasible"]
+               for r in flat}
+    assert verdict[(250, True)] and not verdict[(1, False)]

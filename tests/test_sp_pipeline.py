@@ -51,7 +51,9 @@ def run_sp_pipeline(params, batch, cfg, pp, dp, sp, microbatches,
 
 
 @pytest.mark.parametrize("pp,dp,sp,strategy", [
-    (1, 1, 4, "ring"),
+    # sp=4 slow-marked (PR 10 rebalance): the pp2xdp2xsp2 hybrid is the
+    # fast ring rep (more composition per second than the deeper ring)
+    pytest.param(1, 1, 4, "ring", marks=pytest.mark.slow),
     (2, 2, 2, "ring"),
     (1, 1, 2, "ulysses"),
     pytest.param(2, 1, 2, "ring", marks=pytest.mark.slow),

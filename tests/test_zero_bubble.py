@@ -207,11 +207,14 @@ def test_zb1_checkpoint_roundtrips_across_schedules(cfg, params, tmp_path,
 # Stats: [S, v] activation reductions under the split backward
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_zb1_collect_stats_shapes(cfg, params, devices):
     """Per-stage numerics telemetry resolves under zb1: the B ticks fold
     the same chunk-boundary activation stats the fused backward folded, so
     [S, v] and [S] keys exist, finite and positive — and match the
-    interleaved schedule's EXACTLY (same primals, same fold order)."""
+    interleaved schedule's EXACTLY (same primals, same fold order).
+    Slow-marked (PR 10 rebalance): the interleaved stats test is the fast
+    [S, v]-stats gate, and zb1 rides the identical fold path it pins."""
     batch = make_batch(cfg)
     _, _, stats = run_schedule(params, batch, cfg, 2, "zb1", v=2,
                                collect_stats=True)
