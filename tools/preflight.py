@@ -133,11 +133,15 @@ def offload_feasibility(pcfg, dims: tuple, step_compute_s: float,
 # schedule/memory trade instead of hand-picking it — PAPERS.md 2510.05186)
 # ---------------------------------------------------------------------------
 
-def candidate_device_terms_gib(pcfg, dims: tuple) -> dict:
+def candidate_device_terms_gib(pcfg, dims: tuple, vocab: int | None = None
+                               ) -> dict:
     """The schedule-DEPENDENT device-memory terms of one candidate, GiB:
     the stage-input ring buffer and (zb1) the W stash — each replaced by
-    two in-flight transfer slots when its store tiers to host. Everything
-    else in the step (weights, grads, optimizer, transient activations) is
+    two in-flight transfer slots when its store tiers to host — plus, when
+    `vocab` is given, the last stage's loss-head term (the live fp32
+    logits block + chunked-backward dh accumulator of the XLA path; ~0 for
+    `kernels.ce: pallas` — pl.loss_head_bytes). Everything else in the
+    step (weights, grads, optimizer, transient activations) is
     schedule-independent at fixed batch shape, which is what lets selection
     anchor on ONE compiled peak (see select_schedule)."""
     from llama_pipeline_parallel_tpu.parallel import pipeline as pl
@@ -149,20 +153,28 @@ def candidate_device_terms_gib(pcfg, dims: tuple) -> dict:
     stash = pl.wgrad_stash_bytes(pcfg, *dims)
     ring_dev = min(ring, 2 * slot) if pcfg.offload_activations else ring
     stash_dev = min(stash, 4 * slot) if pcfg.offload_wgrad else stash
+    head = (pl.loss_head_bytes(pcfg, mb_rows, local_seqlen, hidden_size,
+                               vocab) if vocab else 0)
     return {"ring_gib": ring_dev / gib, "stash_gib": stash_dev / gib,
-            "host_gib": pl.host_stash_bytes(pcfg, *dims) / gib}
+            "host_gib": pl.host_stash_bytes(pcfg, *dims) / gib,
+            "loss_head_gib": head / gib}
 
 
 def enumerate_candidates(num_stages: int, microbatches: int, num_layers: int,
                          max_virtual: int = 4,
-                         accum_options: tuple = (1, 2, 4, 8)) -> list:
+                         accum_options: tuple = (1, 2, 4, 8),
+                         ce_options: tuple | None = None) -> list:
     """Every valid PipelineConfig in the selection grid: schedule x
     virtual_stages (layer-divisible) x accum_chunks (microbatch-divisible)
     x offload tiers (wgrad for zb1, activations for all hand-written
-    backwards). Validity delegates to PipelineConfig's own constructor —
-    one source of truth for the divisibility rules."""
+    backwards) x — when `ce_options` is given — the loss-head axis, each
+    entry a (loss_chunks, kernel_ce) pair (docs/KERNELS.md; the default
+    keeps the legacy grid so the axis is opt-in). Validity delegates to
+    PipelineConfig's own constructor — one source of truth for the
+    divisibility rules."""
     from llama_pipeline_parallel_tpu.parallel import pipeline as pl
 
+    ce_axis = tuple(ce_options) if ce_options else ((1, False),)
     cands = []
     for schedule in ("1f1b", "interleaved_1f1b", "zb1"):
         vs = ((1,) if schedule == "1f1b" else
@@ -174,35 +186,42 @@ def enumerate_candidates(num_stages: int, microbatches: int, num_layers: int,
                 if schedule == "zb1":
                     offloads += [(True, False), (True, True)]
                 for ow, oa in offloads:
-                    try:
-                        cands.append(pl.PipelineConfig(
-                            num_stages=num_stages,
-                            num_microbatches=microbatches,
-                            schedule=schedule, virtual_stages=v,
-                            accum_chunks=c, offload_wgrad=ow,
-                            offload_activations=oa))
-                    except ValueError:
-                        continue
+                    for ce_chunks, ce_kernel in ce_axis:
+                        try:
+                            cands.append(pl.PipelineConfig(
+                                num_stages=num_stages,
+                                num_microbatches=microbatches,
+                                schedule=schedule, virtual_stages=v,
+                                accum_chunks=c, offload_wgrad=ow,
+                                offload_activations=oa,
+                                loss_chunks=ce_chunks,
+                                kernel_ce=ce_kernel))
+                        except ValueError:
+                            continue
     return cands
 
 
 def select_schedule(candidates: list, base_gib: float, dims: tuple,
                     hbm_gb: float, host_bw_gibps: float,
-                    step_compute_fn, hide_max: float = 1.0) -> tuple:
+                    step_compute_fn, hide_max: float = 1.0,
+                    vocab: int | None = None) -> tuple:
     """Score every candidate against the HBM budget AND the host-bandwidth
     bound, and pick the feasible one with the lowest analytic bubble
     (ties: lower host residency first — never move bytes for nothing —
-    then lower device peak). `base_gib` is the schedule-independent
-    anchor: the as-written config's compiled device peak minus ITS ring
-    and stash terms. `step_compute_fn(pcfg) -> seconds` models the overlap
-    budget (accum_chunks does not change it — same flops, more flushes).
+    then lower device peak; the ce axis resolves through the peak, since
+    the loss-head term is the only byte it moves). `base_gib` is the
+    schedule-independent anchor: the as-written config's compiled device
+    peak minus ITS ring/stash (and, with `vocab`, loss-head) terms.
+    `step_compute_fn(pcfg) -> seconds` models the overlap budget
+    (accum_chunks does not change it — same flops, more flushes).
     Returns (winner_row_or_None, all_rows)."""
     from llama_pipeline_parallel_tpu.parallel import pipeline as pl
 
     rows = []
     for pcfg in candidates:
-        terms = candidate_device_terms_gib(pcfg, dims)
-        est = base_gib + terms["ring_gib"] + terms["stash_gib"]
+        terms = candidate_device_terms_gib(pcfg, dims, vocab)
+        est = (base_gib + terms["ring_gib"] + terms["stash_gib"]
+               + terms["loss_head_gib"])
         feas = offload_feasibility(pcfg, dims, step_compute_fn(pcfg),
                                    host_bw_gibps)
         fits_hbm = est <= hbm_gb
@@ -212,8 +231,11 @@ def select_schedule(candidates: list, base_gib: float, dims: tuple,
             "accum_chunks": pcfg.accum_chunks,
             "offload_wgrad": pcfg.offload_wgrad,
             "offload_activations": pcfg.offload_activations,
+            "loss_chunks": pcfg.loss_chunks,
+            "kernel_ce": pcfg.kernel_ce,
             "est_peak_gib": round(est, 2) + 0.0,  # normalize -0.0
             "host_stash_gib": round(terms["host_gib"], 2) + 0.0,
+            "loss_head_gib": round(terms["loss_head_gib"], 2) + 0.0,
             "bubble_fraction": round(pl.bubble_fraction(pcfg), 4),
             "hide_ratio": feas["offload_hide_ratio"],
             "feasible": fits_hbm and hides,
@@ -229,6 +251,26 @@ def select_schedule(candidates: list, base_gib: float, dims: tuple,
     return winner, rows
 
 
+def ce_axis_options(loss_chunks: int, vocab: int, tp: int) -> tuple | None:
+    """The loss-head axis --select scores (docs/KERNELS.md): the as-written
+    chunking, an 8-way chunked XLA head where the vocab divides, and ONE
+    Pallas option at the kernel's own VMEM sizing — lane-exact 128-wide
+    vocab tiles (V/128 chunks), per pallas_ce_sum_count's contract. The
+    XLA-scale chunk counts are never offered for the kernel: its
+    [d, V/chunks] weight tile at 8 chunks is tens of MiB against ~16 MiB
+    VMEM, a Mosaic refusal interpret-mode CI cannot see. None at tp>1: the
+    head is already vocab-parallel there and the trainer REJECTS
+    loss_chunks/kernels.ce overrides, so selection must not emit them."""
+    if tp > 1:
+        return None
+    opts = {(loss_chunks, False)}
+    if vocab % 8 == 0:
+        opts.add((8, False))
+    if vocab % 128 == 0:
+        opts.add((vocab // 128, True))
+    return tuple(sorted(opts))
+
+
 def select_overrides(row: dict) -> str:
     """The winning candidate as `key=value` config overrides — what the
     operator (or the supervisor's layout ladder) pastes onto the launch
@@ -240,6 +282,10 @@ def select_overrides(row: dict) -> str:
         parts.append("offload.wgrad_stash=true")
     if row["offload_activations"]:
         parts.append("offload.activations=true")
+    if row.get("loss_chunks", 1) > 1:
+        parts.append(f"loss_vocab_chunks={row['loss_chunks']}")
+    if row.get("kernel_ce"):
+        parts.append("kernels.ce=pallas")
     return " ".join(parts)
 
 
@@ -423,6 +469,22 @@ def preflight(cfg: dict, hbm_gb: float, host_bw_gibps: float = 30.0,
         "hbm_budget_gib": hbm_gb,
         "fits": peak_device_gib <= hbm_gb,
     }
+    # The loss head's live term (pl.loss_head_bytes): the [tokens, V/chunks]
+    # fp32 logits block + chunked-backward dh accumulator of the XLA path,
+    # ~0 under kernels.ce=pallas (docs/KERNELS.md) — named so the operator
+    # can see what the ce axis of --select is trading. Under tp the head is
+    # vocab-PARALLEL (each shard's logits block is [tokens, V/tp]; the
+    # loss_chunks/kernels.ce knobs are rejected there), so the shard width
+    # is the vocab the term sees.
+    report["loss_head_gib"] = round(
+        pl.loss_head_bytes(pcfg_real, *dims[:3],
+                           model_cfg.vocab_size // max(mesh_cfg.tp, 1))
+        / gib, 2)
+    kernels_on = [n for n, on in (("ce", pcfg_real.kernel_ce),
+                                  ("prologue", pcfg_real.kernel_prologue))
+                  if on]
+    if kernels_on:
+        report["kernels"] = "+".join(kernels_on)
     if anchor_m:
         report["anchor_microbatches"] = anchor_m
         report["anchor_peak_gib"] = round(peak / gib, 2)
@@ -830,30 +892,46 @@ def _print_selection(cfg: dict, report: dict, args) -> None:
     dims = pl.stash_dims(mb_rows, seq, mesh_cfg.sp, model_cfg.hidden_size,
                          model_cfg.dtype)
     # schedule-independent anchor: the compiled DEVICE peak minus the
-    # as-written config's own ring/stash terms
-    terms = candidate_device_terms_gib(pcfg, dims)
-    base = report["per_device_peak_gib"] - terms["ring_gib"] - terms["stash_gib"]
+    # as-written config's own ring/stash/loss-head terms. The ce axis
+    # (docs/KERNELS.md) only exists at tp=1: under tp the head is already
+    # vocab-parallel and the trainer REJECTS loss_chunks/kernels.ce
+    # overrides, so selection must not recommend them (the head term is
+    # then candidate-invariant and stays inside the anchor). Pallas
+    # candidates are offered CHUNKED only — at loss_chunks=1 the kernel's
+    # [d, V] weight block cannot fit VMEM at production vocabs.
+    vocab = model_cfg.vocab_size if mesh_cfg.tp <= 1 else None
+    terms = candidate_device_terms_gib(pcfg, dims, vocab)
+    base = (report["per_device_peak_gib"] - terms["ring_gib"]
+            - terms["stash_gib"] - terms["loss_head_gib"])
     compute_fn = lambda c: _step_compute_seconds(
         model_cfg, mesh_cfg, c, mb_rows, seq, args.mfu, args.chip_flops)
+    ce_axis = ce_axis_options(pcfg.loss_chunks, model_cfg.vocab_size,
+                              mesh_cfg.tp)
     winner, rows = select_schedule(
         enumerate_candidates(mesh_cfg.pp, pcfg.num_microbatches,
-                             model_cfg.num_hidden_layers),
+                             model_cfg.num_hidden_layers,
+                             ce_options=ce_axis),
         base, dims, args.hbm_gb, args.host_bw_gibps, compute_fn,
-        hide_max=args.hide_ratio_max)
+        hide_max=args.hide_ratio_max, vocab=vocab)
     print(f"schedule selection ({len(rows)} candidates; base "
-          f"{round(base, 2)} GiB + per-candidate ring/stash; "
+          f"{round(base, 2)} GiB + per-candidate ring/stash/loss-head; "
           f"bw {args.host_bw_gibps} GiB/s, mfu {args.mfu}):")
     print(f"  {'schedule':<17} {'v':>2} {'c':>2} {'offload':<12} "
-          f"{'peak GiB':>9} {'host GiB':>9} {'bubble%':>8} {'hide':>6}  verdict")
+          f"{'ce':<10} {'peak GiB':>9} {'host GiB':>9} {'head GiB':>9} "
+          f"{'bubble%':>8} {'hide':>6}  verdict")
     for r in sorted(rows, key=lambda r: (not r["feasible"],
-                                         r["bubble_fraction"])):
+                                         r["bubble_fraction"],
+                                         r["est_peak_gib"])):
         off = "+".join(n for n, on in (("wgrad", r["offload_wgrad"]),
                                        ("acts", r["offload_activations"]))
                        if on) or "-"
+        ce = (f"{'pallas' if r['kernel_ce'] else 'xla'}/"
+              f"{r['loss_chunks']}")
         mark = "*" if r is winner else " "
         print(f" {mark}{r['schedule']:<17} {r['virtual_stages']:>2} "
-              f"{r['accum_chunks']:>2} {off:<12} {r['est_peak_gib']:>9} "
-              f"{r['host_stash_gib']:>9} "
+              f"{r['accum_chunks']:>2} {off:<12} {ce:<10} "
+              f"{r['est_peak_gib']:>9} {r['host_stash_gib']:>9} "
+              f"{r['loss_head_gib']:>9} "
               f"{100 * r['bubble_fraction']:>8.2f} {r['hide_ratio']:>6} "
               f" {'OK' if r['feasible'] else r['why_not']}")
     if winner is None:
