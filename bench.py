@@ -423,7 +423,8 @@ def main() -> None:
                 sbatch = make_batch(m_s)  # one row per microbatch
                 stacked_by_v: dict[int, tuple] = {}  # v -> (manifest, params)
             for sched, v_s in ((("1f1b", 1), ("interleaved_1f1b", 2),
-                                ("zb1", 2)) if pp_s >= 2 else ()):
+                                ("zb1", 2), ("solver", 2))
+                               if pp_s >= 2 else ()):
                 if cfg.num_hidden_layers % (pp_s * v_s) or m_s % pp_s:
                     print(f"bench schedule row {sched} skipped: "
                           f"{cfg.num_hidden_layers} layers / m={m_s} do not "
@@ -436,9 +437,22 @@ def main() -> None:
                         stacked_by_v[v_s] = (man_s,
                                              pl.stack_stages(canonical, man_s))
                     man_s, stacked_s = stacked_by_v[v_s]
+                    seq_s = None
+                    if sched == "solver":
+                        # the list scheduler's drain-interleaved W variant:
+                        # canonical zb1 bubble, compressed W queue — the
+                        # measured point for the solver lane next to the
+                        # three canonical rows (docs/SCHEDULES.md)
+                        from llama_pipeline_parallel_tpu.parallel import (
+                            schedule as usched,
+                        )
+
+                        seq_s = usched.list_schedule(m_s, pp_s, v_s,
+                                                     w_placement="drain")
                     pcfg_s = pl.PipelineConfig(
                         num_stages=pp_s, num_microbatches=m_s,
-                        schedule=sched, virtual_stages=v_s)
+                        schedule=sched, virtual_stages=v_s,
+                        unit_schedule=seq_s)
                     fn = jax.jit(pl.make_pipeline_loss_and_grad(
                         sched_mesh, cfg, pcfg_s, stacked_s))
                     float(fn(stacked_s, sbatch)[0])  # compile off the clock
@@ -453,8 +467,10 @@ def main() -> None:
                         "virtual_stages": v_s, "microbatches": m_s,
                         "bubble_fraction_analytic":
                             round(pl.bubble_fraction(pcfg_s), 4)}
-                    if sched == "zb1":
+                    if pl.wgrad_queue_peak(pcfg_s):
                         detail["wgrad_queue_depth"] = pl.wgrad_queue_peak(pcfg_s)
+                    if sched == "solver":
+                        detail["sequence"] = seq_s.label
                     results[f"extra:sched-{sched},pp={pp_s}"] = {
                         "dt": dt, "tokens_per_step": m_s * seq,
                         "headline": False, "detail": detail}

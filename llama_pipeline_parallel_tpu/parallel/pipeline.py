@@ -13,26 +13,34 @@ Design (and why it is not a translation of DeepSpeed):
   (the analogue of `LayerSpec` lazy per-rank materialization, reference
   models/llama_ds_mp_wrap.py:209-224, but by sharding, not by construction
   order).
-- Four schedules, all skewed microbatch loops where activations hop to the
-  next stage via `jax.lax.ppermute` over the ICI ring (the analogue of NCCL
-  P2P send/recv):
+- The schedule is DATA, not a code path (since PR 11; docs/SCHEDULES.md
+  "Solver schedules"): every hand-written-backward schedule is a typed
+  per-stage unit sequence (parallel/schedule.py UnitSchedule) executed by
+  ONE interpreter (`_pipeline_units_local`) — skewed microbatch loops where
+  activations hop to the next stage via `jax.lax.ppermute` over the ICI
+  ring (the analogue of NCCL P2P send/recv):
   * "1f1b" (default) — the schedule DeepSpeed's engine runs: forward and
     backward interleave in one scan with a hand-written per-stage `jax.vjp`
-    backward, bounding in-flight activations at min(2S-1, M) stage inputs
-    (see `_pipeline_1f1b_local`).
+    backward, bounding in-flight activations at min(2S-1, M) stage inputs.
   * "interleaved_1f1b" — Megatron-style virtual pipeline stages: each stage
     owns `virtual_stages` round-robin layer chunks, the activation laps the
     ring v times per microbatch, and the flush bubble drops ~2vx
-    (see `_pipeline_interleaved_1f1b_local`; docs/SCHEDULES.md).
+    (docs/SCHEDULES.md).
   * "zb1" — the interleaved clock with the backward DECOMPOSED into B
     (input-grad) and W (weight-grad) units, ZB-H1 / 2BP-style: B units
     stay on the critical path, W units replay from stashed residuals in a
-    fourth collective-free phase, dropping the analytic bubble another
-    third below interleaved (`split_backward=True` on the same function;
-    docs/SCHEDULES.md has the unit accounting and the W-stash bound).
+    trailing collective-free W segment, dropping the analytic bubble
+    another third below interleaved (docs/SCHEDULES.md has the unit
+    accounting and the W-stash bound).
+  * "solver" — a loaded sequence file (preflight --select --emit-schedule):
+    anything the validator accepts, including per-unit selective offload
+    of the W residuals and reordered W placements.
+  The named three resolve to canonical generated sequences that replay the
+  deleted hand-written scans bit-exactly.
   * "gpipe" — forward-only scan; JAX autodiff yields the backward pipeline
     automatically (the transpose of `ppermute` is the reverse `ppermute`),
-    at the cost of O(M) stored boundary activations.
+    at the cost of O(M) stored boundary activations. The one non-sequence
+    schedule.
   Per-layer remat (`jax.checkpoint`) bounds within-stage activations,
   mirroring `deepspeed.checkpointing.checkpoint`
   (reference models/llama_ds_mp_wrap.py:57,166).
@@ -60,6 +68,7 @@ the matmul feeding them (see _vocab_parallel_token_loss).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from functools import partial
 from typing import Any, Callable
 
@@ -79,6 +88,7 @@ from llama_pipeline_parallel_tpu.parallel.mesh import (
     AXIS_SP,
     AXIS_TP,
 )
+from llama_pipeline_parallel_tpu.parallel import schedule as usched
 from llama_pipeline_parallel_tpu.utils import compat, host_stash
 from llama_pipeline_parallel_tpu.utils.compat import shard_map
 
@@ -86,7 +96,12 @@ Params = dict
 Batch = dict
 
 
-SCHEDULES = ("1f1b", "interleaved_1f1b", "zb1", "gpipe")
+SCHEDULES = ("1f1b", "interleaved_1f1b", "zb1", "solver", "gpipe")
+
+# The schedules executed by the unit-sequence INTERPRETER
+# (_pipeline_units_local) from a generated/loaded UnitSchedule
+# (parallel/schedule.py); "gpipe" stays the AD-of-the-forward-loop path.
+UNIT_SCHEDULES = ("1f1b", "interleaved_1f1b", "zb1", "solver")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -194,6 +209,17 @@ class PipelineConfig:
     # per-flush M fit per chip. 1f1b/interleaved/zb1 only: gpipe's stored
     # activations are AD-internal (no explicit buffer to hook).
     offload_activations: bool = False
+    # `schedule: solver` — the per-flush unit sequence the interpreter
+    # executes (a parallel/schedule.py UnitSchedule, emitted by
+    # `tools/preflight.py --select --emit-schedule` or loaded from a
+    # sequence file via train.py's `schedule_file` key). Carries its own
+    # per-unit offload decision vector — the selective-offload
+    # generalization of the all-or-nothing `offload.wgrad_stash` boolean
+    # (its all-True/all-False extremes ARE the boolean's two settings).
+    # Excluded from equality/hash: the sequence is derived data validated
+    # for consistency below, not an identity knob.
+    unit_schedule: Any = dataclasses.field(default=None, compare=False,
+                                           repr=False)
 
     def __post_init__(self) -> None:
         from llama_pipeline_parallel_tpu.parallel.sp import SP_STRATEGIES
@@ -218,17 +244,19 @@ class PipelineConfig:
             raise ValueError(
                 f"virtual_stages must be >= 1, got {self.virtual_stages}")
         if self.virtual_stages > 1 and self.schedule not in (
-                "interleaved_1f1b", "zb1"):
+                "interleaved_1f1b", "zb1", "solver"):
             raise ValueError(
                 f"virtual_stages={self.virtual_stages} requires "
-                f"schedule=interleaved_1f1b or zb1 (got {self.schedule!r})")
-        if self.schedule in ("interleaved_1f1b", "zb1"):
+                f"schedule=interleaved_1f1b, zb1, or solver "
+                f"(got {self.schedule!r})")
+        if self.schedule in ("interleaved_1f1b", "zb1", "solver"):
             if self.layer_counts is not None and len(set(self.layer_counts)) != 1:
                 raise ValueError(
                     f"{self.schedule} requires an even stage partition; "
                     f"got layer_counts={self.layer_counts}")
             m_flush = self.num_microbatches // self.accum_chunks
-            if self.virtual_stages > 1 and m_flush % self.num_stages:
+            if (self.schedule != "solver" and self.virtual_stages > 1
+                    and m_flush % self.num_stages):
                 raise ValueError(
                     f"{self.schedule} with virtual_stages="
                     f"{self.virtual_stages} needs microbatches-per-flush "
@@ -236,6 +264,36 @@ class PipelineConfig:
                     f"{m_flush}) divisible by num_stages={self.num_stages} "
                     f"(the round-robin unit groups hold one microbatch per "
                     f"stage)")
+        if self.schedule == "solver":
+            us = self.unit_schedule
+            if us is None:
+                raise ValueError(
+                    "schedule: solver needs a unit sequence — load one with "
+                    "train.py's schedule_file key or emit one via "
+                    "tools/preflight.py --select --emit-schedule")
+            m_flush = self.num_microbatches // self.accum_chunks
+            mismatches = [
+                f"{name}: sequence {got} vs config {want}"
+                for name, got, want in (
+                    ("num_stages", us.num_stages, self.num_stages),
+                    ("virtual_stages", us.virtual_stages,
+                     self.virtual_stages),
+                    ("microbatches-per-flush", us.num_microbatches, m_flush))
+                if got != want]
+            if mismatches:
+                raise ValueError(
+                    f"unit sequence does not fit this run: "
+                    f"{'; '.join(mismatches)}")
+            if self.offload_wgrad:
+                raise ValueError(
+                    "schedule: solver carries its own per-unit offload "
+                    "decision vector — drop offload.wgrad_stash (the "
+                    "boolean is the all-or-nothing special case)")
+            usched.validate(us)
+        elif self.unit_schedule is not None:
+            raise ValueError(
+                f"unit_schedule is only meaningful under schedule: solver "
+                f"(got schedule={self.schedule!r})")
         if self.offload_wgrad and self.schedule != "zb1":
             raise ValueError(
                 f"offload.wgrad_stash requires schedule: zb1 (only the "
@@ -263,18 +321,27 @@ def bubble_fraction(pcfg: PipelineConfig) -> float:
     without a profiler (the measured breakdown OptPipe/SkipPipe-style
     schedule work optimizes against — PAPERS.md).
 
+    Since PR 11 the number is COUNTED from the schedule's emitted unit
+    sequence (schedule.bubble_stats — idle units over wall units in
+    F=B=W costs), not maintained per schedule; the closed forms below
+    document what the canonical sequences count to, and the counted
+    integer pairs reduce to the identical rationals, so the floats are
+    bit-equal to the old formulas. Solver sequences get the same
+    treatment for free; gpipe (no sequence) keeps its closed form.
+
     Every schedule runs S stages over M microbatches in `accum_chunks` (= c)
     sequential flushes of m = M/c microbatches, every tick the same cost
     across stages (in-jit scan: warmup/drain ticks take a full tick's wall
     time even where a stage's slot is masked):
 
     - "1f1b": each flush scans m + 2(S-1) combined fwd+bwd ticks
-      (`_pipeline_1f1b_local`'s num_ticks) of which m are useful per stage
+      (the canonical generated grid's num_ticks) of which m are useful
+      per stage
       -> bubble = 2c(S-1) / (M + 2c(S-1)).
     - "interleaved_1f1b": each flush runs m*v chunk-sized units per stage
       (v = virtual_stages), phased as vS-1 forward-only warmup ticks +
       mv + S - 1 - (vS-1) combined ticks + vS-1 backward-only drain ticks
-      (`_pipeline_interleaved_1f1b_local`). A warmup tick costs one chunk
+      (the canonical interleaved grid's segments). A warmup tick costs one chunk
       FORWARD and a drain tick one chunk BACKWARD, so the two phases pair
       into vS-1 full chunk ticks and the flush totals mv + S - 1 chunk-tick
       equivalents, mv useful -> bubble = c(S-1) / (Mv + c(S-1)) —
@@ -304,27 +371,59 @@ def bubble_fraction(pcfg: PipelineConfig) -> float:
     if s <= 1:
         return 0.0
     m, c = pcfg.num_microbatches, pcfg.accum_chunks
-    if pcfg.schedule == "interleaved_1f1b":
-        mv = m * pcfg.virtual_stages
-        return (s - 1) * c / (mv + (s - 1) * c)
-    if pcfg.schedule == "zb1":
-        mv = m * pcfg.virtual_stages
-        return 2 * (s - 1) * c / (3 * mv + 2 * (s - 1) * c)
-    per_flush = 2 * (s - 1) if pcfg.schedule == "1f1b" else (s - 1)
-    return per_flush * c / (m + per_flush * c)
+    if pcfg.schedule == "gpipe":
+        per_flush = s - 1
+        return per_flush * c / (m + per_flush * c)
+    # Every unit-sequence schedule: COUNT the per-flush sequence's idle
+    # units instead of hand-maintaining a closed form per schedule. The
+    # closed forms above used to live here; the integer (idle, wall) pair
+    # this derives reduces to the identical rational number, so the float
+    # is bit-identical — and solver sequences get the same treatment for
+    # free (the c flushes scale idle and wall together).
+    idle, wall = usched.bubble_stats(_unit_schedule_for(
+        dataclasses.replace(pcfg, num_microbatches=m // c, accum_chunks=1)))
+    return (idle * c) / (wall * c) if wall else 0.0
 
 
 def wgrad_queue_peak(pcfg: PipelineConfig) -> int:
-    """Peak W-queue occupancy (stashed B/W residuals) under `schedule: zb1`
-    — schedule-determined, not data-dependent: every per-flush unit's
-    (chunk input, output cotangent) pair is queued by its B tick and popped
-    only in the W-drain phase, so the peak is the per-flush unit count
-    Mv / accum_chunks (raising accum_chunks is the stash-memory lever, at
-    the usual extra-flush bubble price). 0 for fused-backward schedules —
-    the wgrad_queue_depth metrics/health key (docs/OBSERVABILITY.md)."""
-    if pcfg.schedule != "zb1":
-        return 0
-    return (pcfg.num_microbatches // pcfg.accum_chunks) * pcfg.virtual_stages
+    """Peak W-queue occupancy (stashed B/W residuals, HBM + host slots
+    combined) for any split-backward schedule — schedule-determined, not
+    data-dependent. Canonical zb1 queues every per-flush unit until the
+    trailing W drain, so the peak is Mv / accum_chunks (raising
+    accum_chunks is the stash-memory lever, at the usual extra-flush
+    bubble price); solver sequences that retire W units earlier carry a
+    smaller slot count after liveness reuse (parallel/schedule.py). 0 for
+    fused-backward schedules — the wgrad_queue_depth metrics/health key
+    (docs/OBSERVABILITY.md)."""
+    hbm, host = wgrad_partition(pcfg)
+    return hbm + host
+
+
+def wgrad_partition(pcfg: PipelineConfig) -> tuple[int, int]:
+    """(hbm_slots, host_slots) of the W queue's residual-pair slots — the
+    split every byte model reads: zb1's boolean offload.wgrad_stash puts
+    the whole queue on one side; a solver sequence's per-unit decision
+    vector splits it (with liveness slot reuse per destination buffer)."""
+    if pcfg.schedule == "zb1":
+        peak = (pcfg.num_microbatches // pcfg.accum_chunks) * pcfg.virtual_stages
+        return (0, peak) if pcfg.offload_wgrad else (peak, 0)
+    if pcfg.schedule == "solver" and pcfg.unit_schedule is not None \
+            and pcfg.unit_schedule.split_backward:
+        return (pcfg.unit_schedule.wq_hbm_slots,
+                pcfg.unit_schedule.wq_host_slots)
+    return (0, 0)
+
+
+def wgrad_offloaded_units(pcfg: PipelineConfig) -> int:
+    """Per-flush count of W residuals that CROSS the host link (one D2H at
+    B time + one H2D at W time each) — the traffic term of the offload
+    feasibility bound. Differs from the host SLOT count when liveness
+    reuse packs many units through few slots."""
+    if pcfg.schedule == "zb1" and pcfg.offload_wgrad:
+        return (pcfg.num_microbatches // pcfg.accum_chunks) * pcfg.virtual_stages
+    if pcfg.schedule == "solver" and pcfg.unit_schedule is not None:
+        return pcfg.unit_schedule.offloaded_units
+    return 0
 
 
 def wgrad_stash_bytes(pcfg: PipelineConfig, mb_rows: int, local_seqlen: int,
@@ -342,14 +441,17 @@ def wgrad_stash_bytes(pcfg: PipelineConfig, mb_rows: int, local_seqlen: int,
 def activation_ring_slots(pcfg: PipelineConfig) -> int:
     """Stage-input ring-buffer slots per flush — the schedules' in-flight
     activation store (xbuf): min(2S-1, m) flat, min(2vS-1, mv) chunked
-    (the liveness bounds derived in _pipeline_1f1b_local /
-    _pipeline_interleaved_1f1b_local). 0 where no buffer exists (gpipe's
+    (the liveness bounds the canonical generators encode in
+    UnitSchedule.ring_slots — parallel/schedule.py). 0 where no buffer exists (gpipe's
     store is AD-internal; the flat schedule at S=1 skips its forward half
     entirely)."""
     s, v = pcfg.num_stages, pcfg.virtual_stages
     m_flush = pcfg.num_microbatches // pcfg.accum_chunks
     if pcfg.schedule == "gpipe":
         return 0
+    if pcfg.schedule == "solver" and pcfg.unit_schedule is not None:
+        us = pcfg.unit_schedule
+        return us.ring_slots if bool(us.has_f.any()) else 0
     if pcfg.schedule == "1f1b":
         return min(2 * s - 1, m_flush) if s > 1 else 0
     return min(2 * v * s - 1, m_flush * v)
@@ -385,9 +487,10 @@ def host_stash_bytes(pcfg: PipelineConfig, mb_rows: int, local_seqlen: int,
     off."""
     slot = mb_rows * local_seqlen * hidden_size * dtype_bytes
     total = 0
-    if pcfg.offload_wgrad:
-        total += wgrad_stash_bytes(pcfg, mb_rows, local_seqlen, hidden_size,
-                                   dtype_bytes) + 2 * slot
+    host_slots = wgrad_partition(pcfg)[1]
+    if host_slots:
+        # two buffers per slot + each host ring's one garbage slot
+        total += 2 * host_slots * slot + 2 * slot
     if pcfg.offload_activations and activation_ring_slots(pcfg):
         total += activation_ring_bytes(pcfg, mb_rows, local_seqlen,
                                        hidden_size, dtype_bytes) + slot
@@ -794,7 +897,7 @@ def _act_stat_update_chunk(carry: tuple, y: jnp.ndarray, valid, ch, v: int
 def _sched_act_stats_zero(pcfg: PipelineConfig):
     """Schedule-appropriate zero activation-stat carry (shapes must agree
     across the accum_chunks fold)."""
-    if pcfg.schedule in ("interleaved_1f1b", "zb1"):
+    if pcfg.schedule in ("interleaved_1f1b", "zb1", "solver"):
         return _act_stats_zero_chunks(pcfg.virtual_stages)
     return _ACT_STATS_ZERO()
 
@@ -889,8 +992,8 @@ def _pipeline_loss_local(
     [1, v, k, ...]): the forward walks the v*S virtual-stage ring with the
     interleaved unit ordering, which is what lets
     `make_pipeline_eval_fn` evaluate a training run configured with
-    `schedule: interleaved_1f1b` (training grads for that schedule use
-    `_pipeline_interleaved_1f1b_local`, not AD of this loop)."""
+    `schedule: interleaved_1f1b` (training grads for the unit schedules use
+    the interpreter `_pipeline_units_local`, not AD of this loop)."""
     s_total = pcfg.num_stages
     v = pcfg.virtual_stages
     m_total = pcfg.num_microbatches
@@ -903,8 +1006,8 @@ def _pipeline_loss_local(
     if collect_stats and v > 1:
         raise NotImplementedError(
             "collect_stats on the forward-only loop is gpipe-only; "
-            "interleaved training stats come from "
-            "_pipeline_interleaved_1f1b_local")
+            "interleaved training stats come from the unit-sequence "
+            "interpreter (_pipeline_units_local)")
 
     mb, seqlen, mb_data = _mb_streams(batch, cfg, pcfg)
     num_ticks = n_units + s_total - 1
@@ -1003,54 +1106,94 @@ def _pipeline_loss_local(
     return loss_sum, count
 
 
-def _pipeline_1f1b_local(
+def _unit_schedule_for(pcfg: PipelineConfig):
+    """The PER-FLUSH unit sequence the interpreter executes: the loaded
+    solver sequence, or the canonical generator's re-emission of the named
+    schedule (parallel/schedule.py — the data form of the three deleted
+    hand-written phase scans). Callers pass a pcfg whose num_microbatches
+    is already the per-flush count (accum_chunks=1)."""
+    if pcfg.schedule == "solver":
+        return pcfg.unit_schedule
+    return _canonical_cached(pcfg.schedule,
+                             pcfg.num_microbatches // pcfg.accum_chunks,
+                             pcfg.num_stages, pcfg.virtual_stages,
+                             pcfg.offload_wgrad)
+
+
+@functools.lru_cache(maxsize=64)
+def _canonical_cached(schedule: str, m: int, s: int, v: int,
+                      offload_wgrad: bool):
+    return usched.canonical_schedule(schedule, m, s, v,
+                                     offload_wgrad=offload_wgrad)
+
+
+def _pipeline_units_local(
     params: Params,
     batch: Batch,
     cfg: LlamaConfig,
     pcfg: PipelineConfig,
     attn_fn: Callable,
     global_count: jnp.ndarray,
+    us,
     collect_stats: bool = False,
 ) -> tuple:
-    """One-forward-one-backward schedule with a hand-written backward.
+    """The unit-sequence INTERPRETER: executes any validated UnitSchedule
+    (parallel/schedule.py) inside shard_map — the single replacement for
+    the three hand-written phase scans (flat 1f1b's one-scan
+    warmup/steady/drain formulas, the interleaved three-phase clock, and
+    zb1's fourth W-drain phase), which now exist only as canonical
+    sequences re-emitted by the generator and replayed here bit-exactly.
 
-    Runs INSIDE shard_map; returns this shard's (normalized loss, grads) —
-    the caller psums. This is the schedule DeepSpeed's engine runs inside the
-    reference's `engine.train_batch` (reference trainer_base_ds_mp.py:354):
-    once the pipeline fills, every stage alternates one microbatch forward
-    with one microbatch backward, so in-flight activations are bounded at
-    min(2*num_stages-1, M) stage INPUTS no matter how large the
-    grad-accumulation M is — where the AD-differentiated GPipe scan stores
-    one boundary activation per tick (O(M)) and needs `accum_chunks` flushes
-    (each costing an extra bubble) to stay within HBM.
+    Runs INSIDE shard_map; returns this shard's (normalized loss, grads)
+    — the caller psums. How a sequence executes:
 
-    How the backward is built without AD-of-the-loop: each tick calls
-    `jax.vjp` on the STAGE function at the microbatch being backpropped,
-    recomputing its forward from the buffered stage input — exactly
-    DeepSpeed's activation-checkpointing contract (store the stage boundary,
-    recompute the stage in backward; reference models/llama_ds_mp_wrap.py:57).
-    Timeline (tick t, stage s, S stages, M microbatches):
-
-        forward  of microbatch t - s
-        backward of microbatch t - (2S - 2 - s)
-
-    so the last stage backprops a microbatch the same tick it finishes it,
-    and stage s holds at most 2(S-s)-1 live inputs. Activation cotangents hop
-    backwards over the same ICI ring the forwards hop over (`ppermute` with
-    the reversed permutation — NCCL backward-P2P analogue).
-
-    Embed and the loss head run under `lax.cond` on the stage index: only
-    stage 0 pays the embedding gather (and its backward scatter into [V, d]),
-    only the last stage pays the lm-head matmul + CE — and only on its LIVE
-    backward ticks (loss_gate), not the warmup/drain ones. The cond branches
-    must stay COLLECTIVE-FREE — a collective executed by only some devices
-    aborts/deadlocks the runtime — so the sp label shift is hoisted out to
-    batch level, and under tp>1 the vocab-parallel CE keeps its tp
-    collectives outside the cond with the heavy matmul/statistics gated
-    inside it (_vocab_parallel_token_loss's `last_stage` mode).
+    - Ticks are grouped into SEGMENTS of equal structural flags
+      (has_f/has_b/has_w + ring directions); each segment compiles to one
+      `lax.scan` whose body contains exactly the active halves, with the
+      per-tick [num_stages] unit-index rows as the scan's xs and this
+      stage's entry selected by `jnp.take(row, stage)`. The canonical
+      sequences reproduce the deleted scans' phase structure exactly:
+      flat = one F+B segment (every tick both halves, warmup/drain slots
+      masked), interleaved = F-only warmup / F+B steady / B-only drain,
+      zb1 = those plus a trailing W-only segment.
+    - An idle (-1) slot is masked, not skipped: the forward computes a
+      clipped unit and the predicated buffer write discards it; the
+      backward seeds zero cotangents through the linear vjp; the W replay
+      seeds zeros. Masked work costs a full tick slot (the lockstep-scan
+      model schedule.bubble_stats charges) but contributes EXACTLY zero
+      to every accumulator — which is why an interpreter run is
+      bit-identical to the old scans: the same live units fold in the
+      same order with the same masking, regardless of what masked compute
+      surrounds them.
+    - F units: chunk forward (embed cond-gated on (stage 0, chunk 0)),
+      buffering the received stage input in the `ring_slots` ring for the
+      later backward recompute. B units: the backward — fused schedules
+      vjp w.r.t. (params, input); split-backward sequences vjp w.r.t. the
+      INPUT only (params closed over, so XLA never builds the weight-grad
+      matmuls there) and push the (chunk input, ring cotangent) residual
+      into the W queue, each unit to its `wq_slot` in the HBM or host
+      buffer per the sequence's per-unit `offload_units` decision
+      (PipeOffload-style selective tiering; host pushes stream D2H behind
+      the tick's remaining compute). W units: pop the residual and vjp
+      w.r.t. PARAMS only, folding dparams into the same fp32 accumulators
+      — ascending canonical unit order preserves zb1's bit-exact parity
+      with the fused backward. A W-only segment whose units ALL tier to
+      host runs double-buffered: the scan carries the next unit's pair so
+      its H2D fetch streams behind the current replay (the
+      prefetch-one-ahead contract tests pin).
+    - `ring_fwd`/`ring_bwd` ticks hand activations/cotangents to the ring
+      neighbors via the usual `ppermute`s, outside every cond (the
+      no-collectives-in-divergent-branches rule): the flags are per-tick,
+      identical on every stage, so no device ever skips a collective its
+      peers execute. At S=1 the "ring" degenerates to carrying this
+      tick's output to the next tick.
     """
     s_total = pcfg.num_stages
-    m_total = pcfg.num_microbatches
+    v = us.virtual_stages
+    m_total = us.num_microbatches
+    n_units = us.n_units
+    split = us.split_backward
+    flat_stats = pcfg.schedule == "1f1b"  # scalar per-stage accumulators
     stage = jax.lax.axis_index(AXIS_PP)
     is_first = stage == 0
     is_last = stage == s_total - 1
@@ -1060,251 +1203,15 @@ def _pipeline_1f1b_local(
 
     mb, seqlen, mb_data = _mb_streams(batch, cfg, pcfg)
 
-    def stage_fwd(p, x_in, my_ids, pad, cos, sin, targets, with_loss,
-                  loss_gate=None):
-        """`targets` are next-token labels already aligned with this slab
-        (the sp cross-shard shift happens at TICK level, outside any cond —
-        a collective must never sit inside a stage-divergent branch: only
-        some devices would execute it, which deadlocks/aborts the runtime).
-
-        `loss_gate`: scalar bool (the schedule's b_valid) — warmup/drain
-        ticks whose loss would be masked anyway skip the head compute
-        entirely. NOT stage-uniform (b_valid depends on the stage index); it
-        is only uniform WITHIN one tp group, so it may gate the tp-local
-        head work but must never gate a collective — not even a tp one,
-        since keeping all collectives unconditional is what makes their
-        uniformity hold by construction.
-        """
-        x0 = jax.lax.cond(
-            is_first,
-            lambda emb, x: llama.embed({"embed": emb}, my_ids, cfg),
-            lambda emb, x: x,
-            p["embed"], x_in)
-        local_layers = jax.tree.map(lambda a: a[0], p["layers"])
-        k_max = jax.tree.leaves(local_layers)[0].shape[0]
-        y = llama.run_layers(local_layers, x0, pad, cos, sin, cfg, attn_fn=attn_fn,
-                             remat=pcfg.remat, tp_axis=tp_axis,
-                             remat_policy=pcfg.remat_policy,
-                             slot_valid=_slot_valid(pcfg, stage, tp_size,
-                                                    sp_size, k_max),
-                             pallas_prologue=pcfg.kernel_prologue)
-        if not with_loss:
-            return y
-
-        gate = is_last if loss_gate is None else is_last & loss_gate
-        if tp_size > 1:
-            # The vocab-parallel CE's tp collectives run stage-uniformly; the
-            # heavy matmul + CE stats inside it are cond-gated to `gate`
-            # (see _vocab_parallel_token_loss). final_norm stays unmasked —
-            # elementwise [mb, L, d], negligible — because tp_copy must sit
-            # between it and the matmul for complete norm grads.
-            h = llama.final_norm({"norm": p["norm"]}, y, cfg)
-            mb_sum = _vocab_parallel_token_loss(
-                {"lm_head": p["lm_head"]}, h, targets, cfg,
-                preshifted=True, last_stage=gate)[0]
-        else:
-            def head_branch(norm_w, head_w, y_):
-                h = llama.final_norm({"norm": norm_w}, y_, cfg)
-                if pcfg.loss_chunks > 1 or pcfg.kernel_ce:
-                    return _head_ce_sum_count(pcfg)(
-                        h, head_w.astype(cfg.dtype), targets)[0]
-                logits = llama.lm_head({"lm_head": head_w}, h, cfg)
-                return llama.token_loss_sum_and_count_preshifted(logits, targets)[0]
-
-            mb_sum = jax.lax.cond(
-                gate, head_branch, lambda norm_w, head_w, y_: jnp.float32(0.0),
-                p["norm"], p["lm_head"], y)
-        return y, mb_sum
-
-    num_ticks = m_total + 2 * (s_total - 1)
-    b_slots = min(2 * s_total - 1, m_total)
-    hidden_shape = (mb, seqlen, cfg.hidden_size)
-
-    def tick(carry, t):
-        x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats = carry
-
-        if s_total > 1:
-            # -- forward half: microbatch t - stage -----------------------
-            fm = t - stage
-            f_valid = (fm >= 0) & (fm < m_total)
-            fm_c = jnp.clip(fm, 0, m_total - 1)
-            ids_f, pad_f, cos_f, sin_f, _ = mb_data(fm_c)
-            y_f = stage_fwd(params, x_recv, ids_f, pad_f, cos_f, sin_f, None,
-                            with_loss=False)
-            # Buffer the raw received stage input for the later backward
-            # recompute (slot is free: a colliding index would be >= b_slots
-            # microbatches old, past its backward tick). The write is still
-            # predicated so drain-phase ticks (fm clipped onto m_total-1) can
-            # never clobber a live slot — via `where(valid, new, old)` in
-            # HBM, via the host stash's garbage slot when the ring tiers to
-            # host DRAM (utils/host_stash.py; an RMW on a host slot would
-            # bounce the old value H2D just to write it back).
-            slot_f = fm_c % b_slots
-            if pcfg.offload_activations:
-                xbuf = host_stash.stash_push(xbuf, x_recv, slot_f, f_valid)
-            else:
-                old = jax.lax.dynamic_index_in_dim(xbuf, slot_f, keepdims=False)
-                xbuf = jax.lax.dynamic_update_index_in_dim(
-                    xbuf, jnp.where(f_valid, x_recv, old), slot_f, 0)
-
-        # -- backward half: microbatch t - (2S - 2 - stage) ---------------
-        # (at S=1 the schedule degenerates to one vjp per tick — there is no
-        # handoff to produce, so the forward half above is skipped entirely
-        # and nothing is buffered: x_in is dead, stage 0's cond re-embeds)
-        bm = t - (2 * (s_total - 1) - stage)
-        b_valid = (bm >= 0) & (bm < m_total)
-        bm_c = jnp.clip(bm, 0, m_total - 1)
-        ids_b, pad_b, cos_b, sin_b, targets_b = mb_data(bm_c)
-        if s_total <= 1:
-            x_in_b = x_recv
-        elif pcfg.offload_activations:
-            # H2D fetch dispatched at the top of the backward half — the
-            # copy overlaps the forward half's compute above it (no data
-            # dependence between them; XLA's async copy-start/copy-done)
-            x_in_b = host_stash.stash_pop(xbuf, bm_c % b_slots)
-        else:
-            x_in_b = jax.lax.dynamic_index_in_dim(xbuf, bm_c % b_slots,
-                                                  keepdims=False)
-
-        def h(p, x_in):
-            return stage_fwd(p, x_in, ids_b, pad_b, cos_b, sin_b, targets_b,
-                             with_loss=True, loss_gate=b_valid)
-
-        (y_b, mb_sum), pullback = jax.vjp(h, params, x_in_b)
-        if collect_stats:
-            # Stage-boundary activation stats from the backward half's
-            # recompute (the same activation the forward produced; using the
-            # backward side covers S=1, whose forward half is skipped, with
-            # the same b_valid gate as the loss).
-            act_stats = _act_stat_update(act_stats, y_b, b_valid)
-        # vjp is linear in the cotangent, so masked-out ticks (zero seeds)
-        # contribute exactly zero to the accumulators — no outer `where`.
-        dy_ct = jnp.where(b_valid & ~is_last, 1.0, 0.0).astype(cfg.dtype) * dy_recv
-        loss_ct = jnp.where(b_valid, 1.0, 0.0) / global_count
-        dparams, dx = pullback((dy_ct, loss_ct))
-        gacc = jax.tree.map(jnp.add, gacc, dparams)
-        loss_acc = loss_acc + jnp.where(b_valid, mb_sum, 0.0)
-
-        # -- handoffs over the ICI ring -----------------------------------
-        if s_total > 1:
-            fwd_perm = [(i, (i + 1) % s_total) for i in range(s_total)]
-            bwd_perm = [(i, (i - 1) % s_total) for i in range(s_total)]
-            x_next = jax.lax.ppermute(y_f, AXIS_PP, fwd_perm)
-            dy_next = jax.lax.ppermute(dx, AXIS_PP, bwd_perm)
-        else:
-            x_next, dy_next = x_recv, dx  # no neighbors; both carries dead
-        return (x_next, dy_next, xbuf, gacc, loss_acc, act_stats), None
-
-    carry0 = (
-        jnp.zeros(hidden_shape, cfg.dtype),
-        jnp.zeros(hidden_shape, cfg.dtype),
-        (host_stash.stash_init(b_slots, hidden_shape, cfg.dtype)
-         if pcfg.offload_activations and s_total > 1
-         else jnp.zeros((b_slots,) + hidden_shape, cfg.dtype)),
-        jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), params),
-        jnp.float32(0.0),
-        _ACT_STATS_ZERO(),
-    )
-    (_, _, _, grads, loss_acc, act_stats), _ = jax.lax.scan(
-        tick, carry0, jnp.arange(num_ticks))
-    # loss_acc is nonzero on the last stage only (cond zero branch elsewhere)
-    if collect_stats:
-        return loss_acc / global_count, grads, act_stats
-    return loss_acc / global_count, grads
-
-
-def _pipeline_interleaved_1f1b_local(
-    params: Params,
-    batch: Batch,
-    cfg: LlamaConfig,
-    pcfg: PipelineConfig,
-    attn_fn: Callable,
-    global_count: jnp.ndarray,
-    collect_stats: bool = False,
-    split_backward: bool = False,
-) -> tuple:
-    """Interleaved one-forward-one-backward: virtual pipeline stages
-    (Megatron-style, OptPipe/PAPERS.md trade space) with the SAME
-    hand-written per-tick `jax.vjp` backward as the flat schedule.
-
-    `split_backward` (schedule: zb1) decomposes the fused per-tick backward
-    into the two separately schedulable units of the zero-bubble family
-    (ZB-H1 / 2BP, PAPERS.md): a **B unit** — input-grad only, the cotangent
-    the upstream stage is waiting on, computed by vjp'ing the chunk w.r.t.
-    its INPUT with params closed over (so XLA never builds the weight-grad
-    matmuls there) — and a **W unit** — weight-grad only, replayed later
-    from a stashed (chunk input, output cotangent) residual. B units keep
-    the steady/drain tick clock; every B tick pushes its residual into the
-    W queue, and a fourth, collective-free `lax.scan` phase drains the
-    queue after the ring goes quiet, folding each W unit's dparams into the
-    SAME fp32 accumulators in the SAME unit order as the fused backward —
-    which is why zb1 stays bit-identical to flat/interleaved (the fused
-    pullback computes (dparams, dx) from one residual set; splitting it
-    re-runs the identical chunk recompute + cotangent chain per unit and
-    changes only WHEN dparams are materialized, not what is summed).
-    The stash is the price: 2 x N hidden-sized buffers per flush
-    (N = m*v units; `wgrad_queue_peak` / `wgrad_stash_bytes`, checked by
-    tools/preflight.py). At v=1 this is the flat zero-bubble schedule.
-
-    Runs INSIDE shard_map; returns this shard's (normalized loss, grads) —
-    the caller psums. Each stage owns v = `virtual_stages` round-robin layer
-    chunks (manifest.py; layer leaves [1, v, k, ...] locally), so one
-    microbatch laps the pp ring v times and the pipeline FILL shrinks from
-    S full-stage forwards to vS chunk forwards — 1/v of a microbatch's
-    forward work per fill slot. Scheduling unit = (microbatch, chunk); unit
-    ordering and why the plain ring ppermute carries chunk transitions too:
-    see `_unit_mb_chunk`. Timeline (tick t, stage s, S stages, M
-    microbatches, N = Mv units, D = (v+1)S - 2):
-
-        forward  of unit t - s
-        backward of unit t - (D - s)
-
-    so the last stage backprops the last chunk of a microbatch the same tick
-    it finishes it (at v=1 this IS the flat schedule: D = 2S - 2). The run
-    is phased into three scans over the same tick clock:
-
-        [0, vS-1)          forward-only warmup  (no backward work exists
-                           anywhere: the first unit only clears the vS-1
-                           ring hops of the virtual pipeline at tick vS-1)
-        [vS-1, N+S-1)      steady 1F1B, both halves per tick
-        [N+S-1, N+D)       backward-only drain (all forwards are done)
-
-    Phasing is what buys the interleaved bubble: a warmup tick costs one
-    chunk FORWARD (not a full fwd+bwd tick with a masked backward half), a
-    drain tick one chunk backward, so warmup+drain pair into vS-1 full
-    chunk ticks and the flush totals Mv + S - 1 chunk-tick equivalents —
-    bubble (S-1)/(Mv + S - 1), vs 2(S-1)/(M + 2(S-1)) flat
-    (`bubble_fraction`; docs/SCHEDULES.md has the accounting).
-
-    Ring-buffer liveness for v chunks: unit f's input slot (f mod B) is
-    reused by unit f + B at tick f + B + s; unit f's backward reads it at
-    tick f + (v-1-2*ch)S + D - s <= f + (v-1)S + D - s, and
-    B = 2vS - 1 > (v-1)S + D - 2s for all s >= 0 — so B = min(2vS-1, Mv)
-    slots suffice, the v-chunk generalization of the flat min(2S-1, M).
-    Warmup/drain masking is zero cotangents through the linear vjp, exactly
-    as the flat schedule does; embed runs under `lax.cond` on
-    (stage 0, chunk 0), the loss head on (last stage, chunk v-1, live),
-    with every collective kept outside stage-divergent conds (the same
-    hard rule, see `_pipeline_1f1b_local`)."""
-    s_total = pcfg.num_stages
-    v = pcfg.virtual_stages
-    m_total = pcfg.num_microbatches
-    n_units = m_total * v
-    stage = jax.lax.axis_index(AXIS_PP)
-    is_first = stage == 0
-    is_last = stage == s_total - 1
-    tp_size = compat.axis_size(AXIS_TP)
-    tp_axis = AXIS_TP if tp_size > 1 else None
-
-    mb, seqlen, mb_data = _mb_streams(batch, cfg, pcfg)
-
     def chunk_fwd(p, x_in, ch, my_ids, pad, cos, sin, targets, with_loss,
                   loss_gate=None):
         """One virtual chunk forward (+ cond-gated loss head). `ch` is the
         traced virtual-chunk index; the chunk's layers are dynamically
         sliced from the [v, k, ...] local leaves, so the param-side vjp
         scatter-adds each chunk's gradient into its own slice (zeros
-        elsewhere — exact, not approximate)."""
+        elsewhere — exact, not approximate). At v == 1 this IS the flat
+        stage function, including cond-skipping an uneven partition's
+        padded layer slots where that is safe (_slot_valid)."""
         x0 = jax.lax.cond(
             is_first & (ch == 0),
             lambda emb, x: llama.embed({"embed": emb}, my_ids, cfg),
@@ -1316,9 +1223,13 @@ def _pipeline_interleaved_1f1b_local(
             chunk_layers = jax.tree.map(
                 lambda a: jax.lax.dynamic_index_in_dim(a[0], ch, keepdims=False),
                 p["layers"])
+        k_max = jax.tree.leaves(chunk_layers)[0].shape[0]
         y = llama.run_layers(chunk_layers, x0, pad, cos, sin, cfg,
                              attn_fn=attn_fn, remat=pcfg.remat,
                              tp_axis=tp_axis, remat_policy=pcfg.remat_policy,
+                             slot_valid=_slot_valid(pcfg, stage, tp_size,
+                                                    sp_size, k_max)
+                             if v == 1 else None,
                              pallas_prologue=pcfg.kernel_prologue)
         if not with_loss:
             return y
@@ -1328,7 +1239,7 @@ def _pipeline_interleaved_1f1b_local(
         if tp_size > 1:
             # tp collectives stay stage-uniform; the heavy matmul + CE stats
             # are cond-gated inside (_vocab_parallel_token_loss, `last_stage`
-            # mode) — identical structure to the flat schedule's head.
+            # mode) — the no-collectives-in-divergent-branches rule.
             h = llama.final_norm({"norm": p["norm"]}, y, cfg)
             mb_sum = _vocab_parallel_token_loss(
                 {"lm_head": p["lm_head"]}, h, targets, cfg,
@@ -1347,33 +1258,39 @@ def _pipeline_interleaved_1f1b_local(
                 p["norm"], p["lm_head"], y)
         return y, mb_sum
 
-    warm = v * s_total - 1
-    d_off = (v + 1) * s_total - 2
-    num_ticks = n_units + d_off
-    fwd_end = n_units + s_total - 1  # first tick with no forward work anywhere
-    n_steady = max(fwd_end - warm, 0)
-    n_drain = num_ticks - warm - n_steady
-    b_slots = min(2 * v * s_total - 1, n_units)
+    b_slots = us.ring_slots
     hidden_shape = (mb, seqlen, cfg.hidden_size)
     fwd_perm = [(i, (i + 1) % s_total) for i in range(s_total)]
     bwd_perm = [(i, (i - 1) % s_total) for i in range(s_total)]
 
-    def fwd_half(t, x_recv, xbuf):
-        f = t - stage
-        f_valid = (f >= 0) & (f < n_units)
+    # -- the sequence's grids as device constants ---------------------------
+    import numpy as np
+
+    f_tbl = jnp.asarray(us.f_unit, jnp.int32)
+    b_tbl = jnp.asarray(us.b_unit, jnp.int32)
+    w_tbl = jnp.asarray(us.w_unit, jnp.int32)
+    off_np = us.offload_units if split else np.zeros(0, bool)
+    n_off = int(off_np.sum()) if split else 0
+    n_keep_units = (n_units - n_off) if split else 0
+    wq_slot_tbl = jnp.asarray(us.wq_slot, jnp.int32) if split else None
+    off_tbl = jnp.asarray(off_np) if split and 0 < n_off < n_units else None
+    use_act_stash = pcfg.offload_activations and bool(us.has_f.any())
+
+    def fwd_half(f_row, x_recv, xbuf):
+        f = jnp.take(f_row, stage)
+        f_valid = f >= 0
         f_c = jnp.clip(f, 0, n_units - 1)
         mb_f, ch_f = _unit_mb_chunk(f_c, s_total, v)
         ids_f, pad_f, cos_f, sin_f, _ = mb_data(jnp.clip(mb_f, 0, m_total - 1))
         y_f = chunk_fwd(params, x_recv, ch_f, ids_f, pad_f, cos_f, sin_f,
                         None, with_loss=False)
         # Buffer the raw received chunk input for the later backward
-        # recompute; predicated so warmup/drain clipping never clobbers a
-        # live slot (same contract as the flat schedule's buffer; under
-        # offload.activations the ring lives in host DRAM and predication
-        # routes invalid writes to the stash's garbage slot instead of the
-        # RMW — utils/host_stash.py).
+        # recompute; predicated so masked slots never clobber a live one
+        # (under offload.activations the ring lives in host DRAM and
+        # predication routes invalid writes to the stash's garbage slot
+        # instead of an RMW — utils/host_stash.py).
         slot_f = f_c % b_slots
-        if pcfg.offload_activations:
+        if use_act_stash:
             xbuf = host_stash.stash_push(xbuf, x_recv, slot_f, f_valid)
         else:
             old = jax.lax.dynamic_index_in_dim(xbuf, slot_f, keepdims=False)
@@ -1381,9 +1298,65 @@ def _pipeline_interleaved_1f1b_local(
                 xbuf, jnp.where(f_valid, x_recv, old), slot_f, 0)
         return y_f, xbuf
 
-    def bwd_half(t, dy_recv, xbuf, gacc, loss_acc, act_stats, wq):
-        g = t - (d_off - stage)
-        b_valid = (g >= 0) & (g < n_units)
+    def wq_push(wq, g_c, valid, x_val, dy_val):
+        """Push one W residual pair to its sequence-assigned destination:
+        the HBM queue via a predicated where-write, the host queue via the
+        stash's garbage-slot predication (one D2H per buffer, streaming
+        behind the tick's remaining compute). Mixed sequences write both
+        buffers with complementary predicates — NOTE the garbage-slot
+        push is still a real D2H, so a mixed vector pays the FULL link
+        traffic (preflight.offload_traffic_bytes charges it); the
+        selective win is host residency (few live slots), not bytes
+        moved."""
+        slot = jnp.take(wq_slot_tbl, g_c)
+        parts = list(wq)
+        i = 0
+        if n_keep_units:
+            keep_ok = valid if off_tbl is None else \
+                valid & ~jnp.take(off_tbl, g_c)
+            slot_k = jnp.clip(slot, 0, us.wq_hbm_slots - 1)
+            for j, val in ((0, x_val), (1, dy_val)):
+                old = jax.lax.dynamic_index_in_dim(parts[i + j], slot_k,
+                                                   keepdims=False)
+                parts[i + j] = jax.lax.dynamic_update_index_in_dim(
+                    parts[i + j], jnp.where(keep_ok, val, old), slot_k, 0)
+            i += 2
+        if n_off:
+            off_ok = valid if off_tbl is None else \
+                valid & jnp.take(off_tbl, g_c)
+            slot_h = jnp.clip(slot, 0, us.wq_host_slots - 1)
+            for j, val in ((0, x_val), (1, dy_val)):
+                parts[i + j] = host_stash.stash_push(parts[i + j], val,
+                                                     slot_h, off_ok)
+        return tuple(parts)
+
+    def wq_pop(wq, g_c):
+        """Fetch unit g's residual pair from whichever buffer holds it
+        (mixed sequences read BOTH buffers and where-select — the host pop
+        is a real H2D either way, counted by the traffic model)."""
+        slot = jnp.take(wq_slot_tbl, g_c)
+        i = 0
+        kept = hosted = None
+        if n_keep_units:
+            slot_k = jnp.clip(slot, 0, us.wq_hbm_slots - 1)
+            kept = tuple(jax.lax.dynamic_index_in_dim(wq[i + j], slot_k,
+                                                      keepdims=False)
+                         for j in (0, 1))
+            i += 2
+        if n_off:
+            slot_h = jnp.clip(slot, 0, us.wq_host_slots - 1)
+            hosted = tuple(host_stash.stash_pop(wq[i + j], slot_h)
+                           for j in (0, 1))
+        if kept is None:
+            return hosted
+        if hosted is None:
+            return kept
+        is_off = jnp.take(off_tbl, g_c)
+        return tuple(jnp.where(is_off, h, k) for h, k in zip(hosted, kept))
+
+    def bwd_half(b_row, dy_recv, xbuf, gacc, loss_acc, act_stats, wq):
+        g = jnp.take(b_row, stage)
+        b_valid = g >= 0
         g_c = jnp.clip(g, 0, n_units - 1)
         mb_b, ch_b = _bwd_unit_mb_chunk(g_c, s_total, v)
         mb_b = jnp.clip(mb_b, 0, m_total - 1)
@@ -1391,10 +1364,10 @@ def _pipeline_interleaved_1f1b_local(
         f_idx = ((g_c // (v * s_total)) * (v * s_total)
                  + ch_b * s_total + g_c % s_total)
         ids_b, pad_b, cos_b, sin_b, targets_b = mb_data(mb_b)
-        if pcfg.offload_activations:
-            # dispatched at the top of the backward half so the H2D copy
-            # overlaps the forward half's compute (steady phase) — see the
-            # flat schedule's identical hook
+        if use_act_stash:
+            # H2D fetch dispatched at the top of the backward half — the
+            # copy overlaps the forward half's compute above it (no data
+            # dependence between them; XLA's async copy-start/copy-done)
             x_in_b = host_stash.stash_pop(xbuf, f_idx % b_slots)
         else:
             x_in_b = jax.lax.dynamic_index_in_dim(xbuf, f_idx % b_slots,
@@ -1404,19 +1377,24 @@ def _pipeline_interleaved_1f1b_local(
             return chunk_fwd(p, x_in, ch_b, ids_b, pad_b, cos_b, sin_b,
                              targets_b, with_loss=True, loss_gate=b_valid)
 
-        if split_backward:
-            # B unit (zb1): input-grad only. Params are CLOSED OVER, so the
-            # vjp never builds the weight-grad matmuls — the tick pays just
+        if split:
+            # B unit: input-grad only. Params are CLOSED OVER, so the vjp
+            # never builds the weight-grad matmuls — the tick pays just
             # the chunk recompute + the cotangent chain the upstream stage
-            # is waiting on. The (input, cotangent) residual is stashed for
-            # the W-drain phase below.
+            # is waiting on. The (input, cotangent) residual is stashed
+            # for the sequence's W units.
             (y_b, mb_sum), pullback = jax.vjp(lambda x: h(params, x), x_in_b)
         else:
             (y_b, mb_sum), pullback = jax.vjp(h, params, x_in_b)
         if collect_stats:
-            # chunk-boundary activation stats from the backward recompute,
-            # indexed [v] by this unit's chunk (-> [S, v] after stitching)
-            act_stats = _act_stat_update_chunk(act_stats, y_b, b_valid, ch_b, v)
+            # stage/chunk-boundary activation stats from the backward
+            # recompute (covers S=1, whose forward half may not exist,
+            # with the same b_valid gate as the loss)
+            if flat_stats:
+                act_stats = _act_stat_update(act_stats, y_b, b_valid)
+            else:
+                act_stats = _act_stat_update_chunk(act_stats, y_b, b_valid,
+                                                   ch_b, v)
         # Only the (last stage, chunk v-1) unit ends the virtual pipeline —
         # every OTHER last-stage chunk's output went to stage 0, so it DOES
         # consume the ring cotangent. vjp is linear in the cotangent, so
@@ -1424,155 +1402,157 @@ def _pipeline_interleaved_1f1b_local(
         owns_loss = is_last & (ch_b == v - 1)
         dy_ct = jnp.where(b_valid & ~owns_loss, 1.0, 0.0).astype(cfg.dtype) * dy_recv
         loss_ct = jnp.where(b_valid, 1.0, 0.0) / global_count
-        if split_backward:
+        if split:
             (dx,) = pullback((dy_ct, loss_ct))
-            # W-queue push at slot g: every unit is stashed exactly once
-            # (b_valid covers [0, n_units)); predicated so warmup/drain
-            # clipping can never clobber slot 0 / n_units-1 after their
-            # valid write (the same contract as xbuf's predicated store).
-            # Under offload.wgrad_stash the queue lives in host DRAM: the
-            # pair goes D2H the tick its B unit retires, behind the tick's
-            # remaining compute (utils/host_stash.py).
-            wq_x, wq_dy = wq
-            if pcfg.offload_wgrad:
-                wq_x = host_stash.stash_push(wq_x, x_in_b, g_c, b_valid)
-                wq_dy = host_stash.stash_push(wq_dy, dy_ct, g_c, b_valid)
-            else:
-                old_x = jax.lax.dynamic_index_in_dim(wq_x, g_c, keepdims=False)
-                old_dy = jax.lax.dynamic_index_in_dim(wq_dy, g_c, keepdims=False)
-                wq_x = jax.lax.dynamic_update_index_in_dim(
-                    wq_x, jnp.where(b_valid, x_in_b, old_x), g_c, 0)
-                wq_dy = jax.lax.dynamic_update_index_in_dim(
-                    wq_dy, jnp.where(b_valid, dy_ct, old_dy), g_c, 0)
-            wq = (wq_x, wq_dy)
+            wq = wq_push(wq, g_c, b_valid, x_in_b, dy_ct)
         else:
             dparams, dx = pullback((dy_ct, loss_ct))
             gacc = jax.tree.map(jnp.add, gacc, dparams)
         loss_acc = loss_acc + jnp.where(b_valid, mb_sum, 0.0)
         return dx, gacc, loss_acc, act_stats, wq
 
-    # -- the phased tick clock: three ring phases (+ zb1's W drain) ---------
-    # (ppermutes sit outside every cond and run phase-uniformly: the phase
-    # boundary is a function of the tick index alone, identical on every
-    # stage, so no device ever skips a collective its peers execute. The
-    # zb1 W-drain phase contains no collective at all — pure per-stage
-    # weight-grad replays — so it needs no clock agreement beyond the scan.)
+    loss_ct_w = jnp.float32(1.0) / global_count
 
-    def warm_tick(carry, t):
-        x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats, *wq = carry
-        y_f, xbuf = fwd_half(t, x_recv, xbuf)
-        x_next = (jax.lax.ppermute(y_f, AXIS_PP, fwd_perm)
-                  if s_total > 1 else y_f)
-        return (x_next, dy_recv, xbuf, gacc, loss_acc, act_stats, *wq), None
+    def w_replay(gacc, g, x_w, dy_w, valid):
+        """One W unit: vjp the chunk w.r.t. PARAMS from its residual pair
+        and fold dparams into the fp32 accumulators (the canonical
+        sequences replay in ascending unit order = the fused backward's
+        fold order = bit-exact parity; masked slots seed exact zeros)."""
+        mb_w, ch_w = _bwd_unit_mb_chunk(g, s_total, v)
+        ids_w, pad_w, cos_w, sin_w, targets_w = mb_data(mb_w)
 
-    def steady_tick(carry, t):
-        x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats, *wq = carry
-        y_f, xbuf = fwd_half(t, x_recv, xbuf)
-        dx, gacc, loss_acc, act_stats, wq = bwd_half(
-            t, dy_recv, xbuf, gacc, loss_acc, act_stats, tuple(wq))
-        if s_total > 1:
-            x_next = jax.lax.ppermute(y_f, AXIS_PP, fwd_perm)
-            dy_next = jax.lax.ppermute(dx, AXIS_PP, bwd_perm)
-        else:
-            x_next, dy_next = y_f, dx
-        return (x_next, dy_next, xbuf, gacc, loss_acc, act_stats, *wq), None
+        def h_p(p):
+            return chunk_fwd(p, x_w, ch_w, ids_w, pad_w, cos_w, sin_w,
+                             targets_w, with_loss=True)
 
-    def drain_tick(carry, t):
-        x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats, *wq = carry
-        dx, gacc, loss_acc, act_stats, wq = bwd_half(
-            t, dy_recv, xbuf, gacc, loss_acc, act_stats, tuple(wq))
-        dy_next = (jax.lax.ppermute(dx, AXIS_PP, bwd_perm)
-                   if s_total > 1 else dx)
-        return (x_recv, dy_next, xbuf, gacc, loss_acc, act_stats, *wq), None
+        _, pullback = jax.vjp(h_p, params)
+        dy_seed = jnp.where(valid, dy_w, jnp.zeros_like(dy_w))
+        (dparams,) = pullback((dy_seed, jnp.where(valid, loss_ct_w, 0.0)))
+        return jax.tree.map(jnp.add, gacc, dparams)
 
+    def w_half(w_row, gacc, wq):
+        g = jnp.take(w_row, stage)
+        g_c = jnp.clip(g, 0, n_units - 1)
+        x_w, dy_w = wq_pop(wq, g_c)
+        return w_replay(gacc, g_c, x_w, dy_w, g >= 0)
+
+    # -- segment runner: one lax.scan per run of equal structural flags -----
+    def make_seg_body(has_f, has_b, has_w, r_f, r_b):
+        def body(carry, xs):
+            x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats, *wq = carry
+            wq = tuple(wq)
+            y_f = dx = None
+            if has_f:
+                y_f, xbuf = fwd_half(xs["f"], x_recv, xbuf)
+            if has_b:
+                dx, gacc, loss_acc, act_stats, wq = bwd_half(
+                    xs["b"], dy_recv, xbuf, gacc, loss_acc, act_stats, wq)
+            if has_w:
+                gacc = w_half(xs["w"], gacc, wq)
+            # ring handoffs sit outside every cond and run tick-uniformly;
+            # at S=1 the handoff degenerates to the scan carry itself
+            if r_f:
+                x_recv = (jax.lax.ppermute(y_f, AXIS_PP, fwd_perm)
+                          if s_total > 1 else y_f)
+            if r_b:
+                dy_recv = (jax.lax.ppermute(dx, AXIS_PP, bwd_perm)
+                           if s_total > 1 else dx)
+            return (x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats, *wq), None
+        return body
+
+    def run_w_segment(t0, t1, gacc, wq):
+        """A W-only segment as its own scan over the grad accumulators
+        (the zb1 fourth phase's structure, preserved): in-HBM residuals
+        read directly; an all-host segment runs DOUBLE-BUFFERED — the
+        carry holds unit g's pair already fetched, and the body's first
+        dispatch prefetches unit g+1 H2D with no data dependence on the
+        replay below it, so the copy streams behind the weight-grad
+        compute (the prefetch-one-unit-ahead contract)."""
+        rows = w_tbl[t0:t1]
+        if split and n_off == n_units:
+            host_x, host_dy = wq[0], wq[1]
+
+            def pop_pair(row):
+                g_c = jnp.clip(jnp.take(row, stage), 0, n_units - 1)
+                slot = jnp.clip(jnp.take(wq_slot_tbl, g_c), 0,
+                                us.wq_host_slots - 1)
+                return (host_stash.stash_pop(host_x, slot),
+                        host_stash.stash_pop(host_dy, slot))
+
+            def w_body(carry, xs):
+                gacc, x_w, dy_w = carry
+                row, row_next = xs
+                x_nxt, dy_nxt = pop_pair(row_next)
+                g = jnp.take(row, stage)
+                gacc = w_replay(gacc, jnp.clip(g, 0, n_units - 1), x_w, dy_w,
+                                g >= 0)
+                return (gacc, x_nxt, dy_nxt), None
+
+            rows_next = jnp.concatenate([rows[1:], rows[-1:]])
+            first = pop_pair(rows[0])
+            (gacc, _, _), _ = jax.lax.scan(w_body, (gacc,) + first,
+                                           (rows, rows_next))
+            return gacc
+
+        def w_body(gacc, row):
+            return w_half(row, gacc, wq), None
+
+        gacc, _ = jax.lax.scan(w_body, gacc, rows)
+        return gacc
+
+    # -- initial carry + the segment walk -----------------------------------
     carry = (
         jnp.zeros(hidden_shape, cfg.dtype),
         jnp.zeros(hidden_shape, cfg.dtype),
         (host_stash.stash_init(b_slots, hidden_shape, cfg.dtype)
-         if pcfg.offload_activations
+         if use_act_stash
          else jnp.zeros((b_slots,) + hidden_shape, cfg.dtype)),
         jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), params),
         jnp.float32(0.0),
-        _act_stats_zero_chunks(v),
+        _ACT_STATS_ZERO() if flat_stats else _act_stats_zero_chunks(v),
     )
-    if split_backward:
-        # the W queue: one (chunk input, output cotangent) residual per
-        # per-flush unit — the zb1 stash (wgrad_queue_peak slots; the
-        # memory term tools/preflight.py models and docs/SCHEDULES.md
-        # bounds). accum_chunks shrinks n_units, so chunking is one lever
-        # when this buffer blows the HBM headroom; offload.wgrad_stash is
-        # the other — the queue then lives in host DRAM and HBM never
-        # holds more than the in-flight transfer slots.
-        if pcfg.offload_wgrad:
-            carry = carry + (
-                host_stash.stash_init(n_units, hidden_shape, cfg.dtype),
-                host_stash.stash_init(n_units, hidden_shape, cfg.dtype))
+    if split:
+        # The W queue: the sequence's slot-assigned residual store, HBM
+        # and/or host per the per-unit offload vector (wgrad_partition —
+        # the memory term tools/preflight.py models). accum_chunks shrinks
+        # n_units; the offload vector moves slots off-device entirely.
+        wq0: tuple = ()
+        if n_keep_units:
+            wq0 += (jnp.zeros((us.wq_hbm_slots,) + hidden_shape, cfg.dtype),
+                    jnp.zeros((us.wq_hbm_slots,) + hidden_shape, cfg.dtype))
+        if n_off:
+            wq0 += (host_stash.stash_init(us.wq_host_slots, hidden_shape,
+                                          cfg.dtype),
+                    host_stash.stash_init(us.wq_host_slots, hidden_shape,
+                                          cfg.dtype))
+        carry = carry + wq0
+
+    flags = list(zip(us.has_f.tolist(), us.has_b.tolist(),
+                     us.has_w.tolist(), us.ring_fwd.tolist(),
+                     us.ring_bwd.tolist()))
+    t0 = 0
+    while t0 < len(flags):
+        t1 = t0
+        while t1 < len(flags) and flags[t1] == flags[t0]:
+            t1 += 1
+        has_f, has_b, has_w, r_f, r_b = flags[t0]
+        if has_w and not (has_f or has_b):
+            x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats, *wq = carry
+            gacc = run_w_segment(t0, t1, gacc, tuple(wq))
+            carry = (x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats, *wq)
         else:
-            carry = carry + (jnp.zeros((n_units,) + hidden_shape, cfg.dtype),
-                             jnp.zeros((n_units,) + hidden_shape, cfg.dtype))
-    if warm:
-        carry, _ = jax.lax.scan(warm_tick, carry, jnp.arange(warm))
-    if n_steady:
-        carry, _ = jax.lax.scan(steady_tick, carry,
-                                jnp.arange(warm, warm + n_steady))
-    if n_drain:
-        carry, _ = jax.lax.scan(drain_tick, carry,
-                                jnp.arange(num_ticks - n_drain, num_ticks))
-    _, _, _, grads, loss_acc, act_stats, *wq = carry
+            xs = {}
+            if has_f:
+                xs["f"] = f_tbl[t0:t1]
+            if has_b:
+                xs["b"] = b_tbl[t0:t1]
+            if has_w:
+                xs["w"] = w_tbl[t0:t1]
+            carry, _ = jax.lax.scan(
+                make_seg_body(has_f, has_b, has_w, r_f, r_b), carry, xs)
+        t0 = t1
+    _, _, _, grads, loss_acc, act_stats, *_ = carry
 
-    if split_backward:
-        # -- W drain: pop the queue in B-unit order and replay each unit's
-        # weight grads from its stashed residual. vjp w.r.t. PARAMS only
-        # (the stashed input is a constant), seeded with the stashed ring
-        # cotangent + the same loss cotangent the fused backward used —
-        # every unit here was live (b_valid held at push time), so the
-        # seed is exactly 1/global_count. Folding in ascending unit order
-        # keeps the fp32 accumulation order identical to the fused
-        # backward's, which is what preserves bit-exact parity.
-        wq_x, wq_dy = wq
-        loss_ct_w = jnp.float32(1.0) / global_count
-
-        def w_replay(gacc, g, x_w, dy_w):
-            """One W unit: vjp the chunk w.r.t. PARAMS from its residual
-            pair and fold dparams into the fp32 accumulators (ascending
-            unit order = the fused backward's order = bit-exact parity)."""
-            mb_w, ch_w = _bwd_unit_mb_chunk(g, s_total, v)
-            ids_w, pad_w, cos_w, sin_w, targets_w = mb_data(mb_w)
-
-            def h_p(p):
-                return chunk_fwd(p, x_w, ch_w, ids_w, pad_w, cos_w, sin_w,
-                                 targets_w, with_loss=True)
-
-            _, pullback = jax.vjp(h_p, params)
-            (dparams,) = pullback((dy_w, loss_ct_w))
-            return jax.tree.map(jnp.add, gacc, dparams)
-
-        if pcfg.offload_wgrad:
-            # Double-buffered drain: the carry holds unit g's residual pair
-            # ALREADY in HBM (fetched one tick earlier), and the body's
-            # first dispatch is the H2D fetch of unit g+1 — no data
-            # dependence on the replay below it, so the copy streams behind
-            # unit g's weight-grad compute (the "prefetch one unit ahead"
-            # contract; the last tick's clipped prefetch is dead).
-            def w_tick_prefetch(carry, g):
-                gacc, x_w, dy_w = carry
-                g_next = jnp.minimum(g + 1, n_units - 1)
-                x_nxt = host_stash.stash_pop(wq_x, g_next)
-                dy_nxt = host_stash.stash_pop(wq_dy, g_next)
-                gacc = w_replay(gacc, g, x_w, dy_w)
-                return (gacc, x_nxt, dy_nxt), None
-
-            first = (host_stash.stash_pop(wq_x, jnp.int32(0)),
-                     host_stash.stash_pop(wq_dy, jnp.int32(0)))
-            (grads, _, _), _ = jax.lax.scan(
-                w_tick_prefetch, (grads,) + first, jnp.arange(n_units))
-        else:
-            def w_tick(gacc, g):
-                x_w = jax.lax.dynamic_index_in_dim(wq_x, g, keepdims=False)
-                dy_w = jax.lax.dynamic_index_in_dim(wq_dy, g, keepdims=False)
-                return w_replay(gacc, g, x_w, dy_w), None
-
-            grads, _ = jax.lax.scan(w_tick, grads, jnp.arange(n_units))
     # loss_acc is nonzero on the last stage only (cond zero branch elsewhere)
     if collect_stats:
         return loss_acc / global_count, grads, act_stats
@@ -1602,20 +1582,17 @@ def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn,
     chunk_pcfg = dataclasses.replace(
         pcfg, num_microbatches=pcfg.num_microbatches // chunks, accum_chunks=1)
 
-    if pcfg.schedule in ("1f1b", "interleaved_1f1b", "zb1"):
-        if pcfg.schedule == "1f1b":
-            sched_fn = _pipeline_1f1b_local
-        elif pcfg.schedule == "zb1":
-            # the interleaved phased clock with the backward SPLIT into
-            # B (input-grad) / W (weight-grad) units — docs/SCHEDULES.md
-            sched_fn = partial(_pipeline_interleaved_1f1b_local,
-                               split_backward=True)
-        else:
-            sched_fn = _pipeline_interleaved_1f1b_local
+    if pcfg.schedule in UNIT_SCHEDULES:
+        # ONE interpreter for every hand-written-backward schedule: the
+        # named schedules resolve to their canonical generated sequences,
+        # `solver` to the loaded one (docs/SCHEDULES.md "Solver
+        # schedules"). Generation is trace-time numpy — free.
+        us = _unit_schedule_for(chunk_pcfg)
 
         def chunk_loss_and_grad(p, chunk_batch):
-            out = sched_fn(p, chunk_batch, cfg, chunk_pcfg, attn_fn,
-                           global_count, collect_stats=collect_stats)
+            out = _pipeline_units_local(p, chunk_batch, cfg, chunk_pcfg,
+                                        attn_fn, global_count, us,
+                                        collect_stats=collect_stats)
             return out if collect_stats else (*out, _sched_act_stats_zero(pcfg))
     else:
         def chunk_loss(p, chunk_batch):
@@ -1678,7 +1655,7 @@ def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn,
     n = jax.lax.psum(n, (AXIS_DP, AXIS_SP))
     msq = jax.lax.pmax(msq_sum / jnp.maximum(n, 1.0),
                        AXIS_TP)  # tp replicas agree; pmax re-asserts it
-    if pcfg.schedule in ("interleaved_1f1b", "zb1"):
+    if pcfg.schedule in ("interleaved_1f1b", "zb1", "solver"):
         v = pcfg.virtual_stages
         stage_msq = jax.lax.pmax(
             jnp.sum(msq_sum) / jnp.maximum(jnp.sum(n), 1.0), AXIS_TP)
@@ -1699,7 +1676,7 @@ def _check_stacked_layout(params_like: Params, pcfg: PipelineConfig) -> None:
     here means the manifest and the PipelineConfig came from different
     places; failing at build time beats a shape error deep inside shard_map."""
     shape = tuple(params_like["layers"]["attn"]["wq"].shape)
-    if (pcfg.schedule in ("interleaved_1f1b", "zb1")
+    if (pcfg.schedule in ("interleaved_1f1b", "zb1", "solver")
             and pcfg.virtual_stages > 1):
         if len(shape) != 5 or shape[1] != pcfg.virtual_stages:
             raise ValueError(
@@ -1862,7 +1839,7 @@ def make_pipeline_loss_and_grad(
     if collect_stats:
         stats_specs = {"act_absmax_per_stage": P(AXIS_PP),
                        "act_rms_per_stage": P(AXIS_PP)}
-        if pcfg.schedule in ("interleaved_1f1b", "zb1"):
+        if pcfg.schedule in ("interleaved_1f1b", "zb1", "solver"):
             # [1, v] local -> [S, v] global; the chunk axis is replicated
             stats_specs.update({"act_absmax_per_chunk": P(AXIS_PP),
                                 "act_rms_per_chunk": P(AXIS_PP)})

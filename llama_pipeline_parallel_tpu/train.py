@@ -105,12 +105,36 @@ def _virtual_stages(cfg: dict) -> int:
     manifest agree on it."""
     v = int(cfg.get("virtual_stages", 1) or 1)
     if v > 1 and cfg.get("pipeline_schedule", "1f1b") not in (
-            "interleaved_1f1b", "zb1"):
+            "interleaved_1f1b", "zb1", "solver"):
         raise ValueError(
             f"virtual_stages={v} requires pipeline_schedule: "
-            f"interleaved_1f1b or zb1 (got "
+            f"interleaved_1f1b, zb1, or solver (got "
             f"{cfg.get('pipeline_schedule', '1f1b')!r})")
     return v
+
+
+def _load_unit_schedule(cfg: dict) -> "Any":
+    """The `schedule_file` key under `pipeline_schedule: solver`: a
+    parallel/schedule.py unit-sequence JSON (emitted by
+    `tools/preflight.py --select --emit-schedule <path>`), loaded and
+    validated here so trainer + preflight share one loader. Returns None
+    for the named schedules (they generate their canonical sequences
+    internally)."""
+    if cfg.get("pipeline_schedule", "1f1b") != "solver":
+        if cfg.get("schedule_file"):
+            raise ValueError(
+                "schedule_file only applies under pipeline_schedule: solver "
+                f"(got {cfg.get('pipeline_schedule', '1f1b')!r})")
+        return None
+    path = cfg.get("schedule_file")
+    if not path:
+        raise ValueError(
+            "pipeline_schedule: solver needs schedule_file: <path> — emit "
+            "one with `python tools/preflight.py --config ... --select "
+            "--emit-schedule <path>` (docs/SCHEDULES.md 'Solver schedules')")
+    from llama_pipeline_parallel_tpu.parallel import schedule as usched
+
+    return usched.load(path)
 
 
 def _offload_flags(cfg: dict) -> tuple[bool, bool]:
@@ -165,7 +189,15 @@ def _offload_static(pcfg: "pl.PipelineConfig", mb_rows: int,
     health.json (docs/OBSERVABILITY.md): which residual stores are tiered
     and how many GiB of them are resident in host DRAM. Empty with offload
     off — no always-zero columns, the wgrad_queue_depth policy."""
-    tiers = [name for name, on in (("wgrad_stash", pcfg.offload_wgrad),
+    wgrad_off = pl.wgrad_offloaded_units(pcfg)
+    wgrad_name = "wgrad_stash"
+    if pcfg.schedule == "solver" and wgrad_off:
+        # selective per-unit offload: name how many of the flush's units
+        # tier (the all-True vector reads like the legacy boolean)
+        total = pcfg.unit_schedule.n_units
+        if wgrad_off < total:
+            wgrad_name = f"wgrad_stash[{wgrad_off}/{total}]"
+    tiers = [name for name, on in ((wgrad_name, wgrad_off > 0),
                                    ("activations", pcfg.offload_activations))
              if on]
     if not tiers:
@@ -185,7 +217,7 @@ def _schedule_static_scalars(pcfg: "pl.PipelineConfig") -> dict:
     backward (0 elsewhere; omitted rather than an always-zero column)."""
     out = {"schedule": pcfg.schedule,
            "bubble_fraction": round(pl.bubble_fraction(pcfg), 4)}
-    if pcfg.schedule == "zb1":
+    if pl.wgrad_queue_peak(pcfg):
         out["wgrad_queue_depth"] = pl.wgrad_queue_peak(pcfg)
     return out
 
@@ -196,7 +228,7 @@ def _schedule_health_static(pcfg: "pl.PipelineConfig", topology: dict) -> dict:
     wgrad_queue_depth the metrics line carries — one construction for both
     optimizer paths so the two sinks can never desynchronize."""
     out = {"topology": topology}
-    if pcfg.schedule == "zb1":
+    if pl.wgrad_queue_peak(pcfg):
         out["wgrad_queue_depth"] = pl.wgrad_queue_peak(pcfg)
     return out
 
@@ -240,6 +272,7 @@ def build_pipeline_config(cfg: dict, mesh_cfg: Any, manifest: StageManifest
     kernel_ce, kernel_prologue = _kernel_flags(cfg)
     return pl.PipelineConfig(
         num_stages=mesh_cfg.pp,
+        unit_schedule=_load_unit_schedule(cfg),
         num_microbatches=cfg.get("gradient_accumulation_steps", 1),
         remat=cfg.get("activation_checkpointing", True),
         remat_policy=cfg.get("remat_policy", "nothing_saveable"),
@@ -628,12 +661,14 @@ def _run_training(cfg: dict) -> dict:
     # (pcfg.packed switches the ring's segment streams on).
     packing = _packing_factor(cfg)
     pcfg = build_pipeline_config(cfg, mesh_cfg, manifest)
-    if pcfg.offload_wgrad or pcfg.offload_activations:
+    if (pcfg.offload_wgrad or pcfg.offload_activations
+            or pl.wgrad_offloaded_units(pcfg)):
         from llama_pipeline_parallel_tpu.utils import host_stash
 
         logger.info(
             "host stash enabled (wgrad=%s activations=%s): %s",
-            pcfg.offload_wgrad, pcfg.offload_activations,
+            pcfg.offload_wgrad or pl.wgrad_offloaded_units(pcfg),
+            pcfg.offload_activations,
             "pinned_host memory space — residuals tier to host DRAM"
             if host_stash.transfers_enabled() else
             "transfers gated off (no distinct host memory space on this "

@@ -209,3 +209,122 @@ def test_ce_axis_rescues_a_budget_the_xla_head_blows():
     verdict = {(r["loss_chunks"], r["kernel_ce"]): r["feasible"]
                for r in flat}
     assert verdict[(250, True)] and not verdict[(1, False)]
+
+
+# ---------------------------------------------------------------------------
+# The solver lane (PR 11): list-scheduled sequences with per-unit offload
+# ---------------------------------------------------------------------------
+
+import numpy as np
+
+
+def test_solver_candidates_beat_canonicals_at_65b_shape():
+    """The acceptance case: at the 65B pp8 shape under the PR 8 budget +
+    hide-ratio constraints, the solver emits a sequence preflight scores
+    STRICTLY better than all three canonical schedules — zb1's 0.90%
+    bubble with only the budget-required fraction of residuals tiered, so
+    it wins the (bubble, tiered-bytes, peak) tie-break on tiered bytes."""
+    cands = preflight.enumerate_candidates(S, M, LAYERS)
+    cands += preflight.solver_candidates(S, M, LAYERS, 70.0, DIMS, 95.0)
+    winner, rows = preflight.select_schedule(cands, 70.0, DIMS, 95.0, 30.0,
+                                             COMPUTE)
+    assert winner["schedule"] == "solver"
+    best_canon = min((r for r in rows if r["schedule"] != "solver"
+                      and r["feasible"]),
+                     key=lambda r: (r["bubble_fraction"],
+                                    r["host_stash_gib"],
+                                    r["est_peak_gib"]))
+    assert winner["bubble_fraction"] == best_canon["bubble_fraction"] \
+        == round(14 / 1550, 4)
+    assert winner["host_stash_gib"] < best_canon["host_stash_gib"]
+    assert winner["est_peak_gib"] <= 95.0
+    # selective offload: strictly between the boolean's extremes
+    assert 0 < winner["wgrad_offload_units"] < winner["wgrad_units_total"]
+
+
+def test_solver_offload_boundary_points():
+    """The per-unit decision space contains both `offload.wgrad_stash`
+    extremes: a roomy budget sizes the vector all-False (== off), a budget
+    with no room for any HBM slot sizes it all-True (== on)."""
+    roomy = preflight.solver_candidates(S, M, LAYERS, 70.0, DIMS, 10000.0)
+    assert roomy and all(c.unit_schedule.offloaded_units == 0 for c in roomy)
+    # base 70 + ring ~0.94 + 4 transfer slots 0.25 GiB ~= 71.2: everything
+    # must tier for the 72 GiB budget to hold at the v2 c1 point
+    tight = preflight.solver_candidates(S, M, LAYERS, 70.0, DIMS, 72.0)
+    v2c1 = [c for c in tight if c.virtual_stages == 2 and c.accum_chunks == 1
+            and c.unit_schedule.label.endswith("trailing-w")]
+    assert v2c1 and all(
+        c.unit_schedule.offloaded_units == c.unit_schedule.n_units
+        for c in v2c1)
+    from llama_pipeline_parallel_tpu.parallel import pipeline as _pl
+
+    # and the boundary candidate's byte models equal the boolean's
+    zb = _pl.PipelineConfig(num_stages=S, num_microbatches=M,
+                            schedule="zb1", virtual_stages=2,
+                            offload_wgrad=True)
+    assert _pl.host_stash_bytes(v2c1[0], *DIMS) == \
+        _pl.host_stash_bytes(zb, *DIMS)
+    assert preflight.offload_traffic_bytes(v2c1[0], DIMS) == \
+        preflight.offload_traffic_bytes(zb, DIMS)
+
+
+def test_solver_rows_respect_hide_ratio():
+    """The hide-ratio bound refuses tiered solver rows with the SAME
+    analytic verdict as the boolean candidates — and a MIXED vector is
+    charged the FULL unit traffic, not just its tiered subset: the
+    interpreter's tick-uniform body pushes the host buffer every B tick
+    (non-tiered units land in the garbage slot, but the D2H still moves)
+    and where-selects every W pop from both buffers, so selective offload
+    buys host RESIDENCY, never link bytes — on a starved 0.5 GiB/s link
+    every tiered row is refused, exactly like the boolean
+    (test_starved_host_link_refuses_offload_falls_back_to_interleaved)."""
+    cands = preflight.solver_candidates(S, M, LAYERS, 70.0, DIMS, 95.0)
+    _, rows = preflight.select_schedule(cands, 70.0, DIMS, 95.0, 0.5,
+                                        COMPUTE)
+    tiered = [r for r in rows if r.get("wgrad_offload_units")]
+    assert tiered and all(not r["feasible"] for r in tiered)
+    assert all(r["why_not"] == "offload traffic cannot hide behind compute"
+               for r in tiered)
+    # the mixed rows' traffic equals the boolean's at the same (v, c)
+    from llama_pipeline_parallel_tpu.parallel import pipeline as _pl
+
+    mixed = next(c for c in cands
+                 if 0 < c.unit_schedule.offloaded_units
+                 < c.unit_schedule.n_units)
+    zb = _pl.PipelineConfig(num_stages=S, num_microbatches=M,
+                            schedule="zb1",
+                            virtual_stages=mixed.virtual_stages,
+                            accum_chunks=mixed.accum_chunks,
+                            offload_wgrad=True)
+    assert preflight.offload_traffic_bytes(mixed, DIMS) == \
+        preflight.offload_traffic_bytes(zb, DIMS)
+
+
+def test_select_overrides_solver_row():
+    cands = preflight.enumerate_candidates(S, M, LAYERS)
+    cands += preflight.solver_candidates(S, M, LAYERS, 70.0, DIMS, 95.0)
+    winner, _ = preflight.select_schedule(cands, 70.0, DIMS, 95.0, 30.0,
+                                          COMPUTE)
+    line = preflight.select_overrides(winner)
+    assert "pipeline_schedule=solver" in line
+    assert "schedule_file=<path from --emit-schedule>" in line
+    assert "offload.wgrad_stash" not in line  # the vector, not the boolean
+    line2 = preflight.select_overrides(winner, schedule_file="/tmp/s.json")
+    assert "schedule_file=/tmp/s.json" in line2
+
+
+def test_stash_remedies_derive_from_sequences():
+    """The refusal text's numbers come from counting the emitted sequences'
+    idle ticks, not hard-coded formulas: the named fallback's bubble must
+    equal bubble_fraction of that schedule at this shape."""
+    from llama_pipeline_parallel_tpu.parallel import pipeline as _pl
+
+    zb = _pl.PipelineConfig(num_stages=S, num_microbatches=M,
+                            schedule="zb1", virtual_stages=2)
+    text = preflight.stash_remedies(zb)
+    assert f"{zb.num_microbatches * 2} residual units" in text
+    alt = _pl.PipelineConfig(num_stages=S, num_microbatches=M,
+                             schedule="interleaved_1f1b", virtual_stages=2)
+    assert f"bubble {100 * _pl.bubble_fraction(alt):.2f}%" in text
+    assert f"vs {100 * _pl.bubble_fraction(zb):.2f}%" in text
+    assert "solver" in text

@@ -64,7 +64,7 @@ def counted_device_terms_gib(pcfg, dims: tuple) -> float:
     slot = mb_rows * local_seqlen * hidden_size * dtype_bytes
     total = (pl.activation_ring_bytes(pcfg, *dims)
              + pl.wgrad_stash_bytes(pcfg, *dims))
-    if pcfg.offload_wgrad:
+    if pl.wgrad_partition(pcfg)[1]:
         total += 2 * slot
     if pcfg.offload_activations and pl.activation_ring_slots(pcfg):
         total += slot
@@ -101,8 +101,19 @@ def offload_traffic_bytes(pcfg, dims: tuple) -> int:
     slot = mb_rows * local_seqlen * hidden_size * dtype_bytes
     units = pcfg.num_microbatches * pcfg.virtual_stages
     total = 0
-    if pcfg.offload_wgrad:
-        total += 4 * units * slot
+    # W-residual link traffic. A MIXED per-unit vector is charged the FULL
+    # per-flush unit count, not just the tiered subset: the interpreter's
+    # tick-uniform SPMD body pushes the host buffer every B tick (the
+    # predicate only redirects non-tiered units to the garbage slot — the
+    # D2H copy still moves) and where-selects every W pop from both
+    # buffers (one H2D per unit either way). Selective offload's win is
+    # host RESIDENCY (few slots live), never link bytes — the model must
+    # not promise hiding the hardware won't deliver.
+    hbm_slots, host_slots = pl.wgrad_partition(pcfg)
+    if host_slots:
+        wgrad_units = (pl.wgrad_offloaded_units(pcfg) if hbm_slots == 0
+                       else units // pcfg.accum_chunks)
+        total += 4 * wgrad_units * pcfg.accum_chunks * slot
     if pcfg.offload_activations and pl.activation_ring_slots(pcfg):
         total += 2 * units * slot
     return total
@@ -150,9 +161,10 @@ def candidate_device_terms_gib(pcfg, dims: tuple, vocab: int | None = None
     mb_rows, local_seqlen, hidden_size, dtype_bytes = dims
     slot = mb_rows * local_seqlen * hidden_size * dtype_bytes
     ring = pl.activation_ring_bytes(pcfg, *dims)
-    stash = pl.wgrad_stash_bytes(pcfg, *dims)
     ring_dev = min(ring, 2 * slot) if pcfg.offload_activations else ring
-    stash_dev = min(stash, 4 * slot) if pcfg.offload_wgrad else stash
+    hbm_slots, host_slots = pl.wgrad_partition(pcfg)
+    stash_dev = 2 * hbm_slots * slot + (
+        min(2 * host_slots * slot, 4 * slot) if host_slots else 0)
     head = (pl.loss_head_bytes(pcfg, mb_rows, local_seqlen, hidden_size,
                                vocab) if vocab else 0)
     return {"ring_gib": ring_dev / gib, "stash_gib": stash_dev / gib,
@@ -201,6 +213,88 @@ def enumerate_candidates(num_stages: int, microbatches: int, num_layers: int,
     return cands
 
 
+def solver_candidates(num_stages: int, microbatches: int, num_layers: int,
+                      base_gib: float, dims: tuple, hbm_gb: float,
+                      max_virtual: int = 4,
+                      accum_options: tuple = (1, 2, 4, 8),
+                      head_gib: float = 0.0) -> list:
+    """Solver-EMITTED sequences as selection candidates (the list-scheduling
+    search beyond the three canonical shapes — docs/SCHEDULES.md 'Solver
+    schedules'). For each split-backward (v, accum, W-placement) grid
+    point the list scheduler emits a sequence, then sizes its per-unit
+    offload decision vector against the budget: tier the MINIMUM number
+    of residual units for base + ring + remaining HBM stash slots to fit
+    (fewest tiered bytes at the canonical bubble — strictly better than
+    the all-or-nothing boolean whenever 0 < k < n fits). The k=0 and
+    k=n_units boundary points reproduce `offload.wgrad_stash` off/on
+    exactly. Candidates that cannot fit even fully tiered are emitted
+    fully tiered and left for select_schedule to refuse with the others."""
+    from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+    from llama_pipeline_parallel_tpu.parallel import schedule as usched
+
+    import numpy as np
+
+    gib = 1 << 30
+    mb_rows, local_seqlen, hidden_size, dtype_bytes = dims
+    slot = mb_rows * local_seqlen * hidden_size * dtype_bytes
+    cands = []
+    vs = tuple(v for v in (1, 2, 4)
+               if v <= max_virtual and num_layers % (num_stages * v) == 0)
+    for v in vs:
+        for c in accum_options:
+            if microbatches % c:
+                continue
+            m_flush = microbatches // c
+            if v > 1 and m_flush % num_stages:
+                continue
+            for placement in ("trailing", "drain"):
+                try:
+                    seq = usched.list_schedule(m_flush, num_stages, v,
+                                               w_placement=placement)
+                except usched.ScheduleError:
+                    continue
+
+                def build(vector):
+                    s = usched.with_offload(seq, vector)
+                    return pl.PipelineConfig(
+                        num_stages=num_stages, num_microbatches=microbatches,
+                        schedule="solver", virtual_stages=v, accum_chunks=c,
+                        unit_schedule=s)
+
+                def est(pcfg):
+                    # must mirror select_schedule's scoring, including the
+                    # loss-head term it charges when a vocab is in play
+                    # (`head_gib` — solver rows run the as-written dense
+                    # head; a vector sized without it would come up short
+                    # at exactly the tight budgets this lane exists for)
+                    t = candidate_device_terms_gib(pcfg, dims)
+                    return base_gib + t["ring_gib"] + t["stash_gib"] + head_gib
+
+                n = seq.n_units
+                none_off = build(np.zeros(n, bool))
+                if est(none_off) <= hbm_gb:
+                    k = 0
+                else:
+                    # minimal k: tier the earliest-scheduled units first
+                    # (their transfers start streaming soonest); binary
+                    # search on the actual slot assignment, not the
+                    # arithmetic guess — drain placements reuse slots
+                    lo, hi = 1, n
+                    while lo < hi:
+                        mid = (lo + hi) // 2
+                        vec = np.zeros(n, bool)
+                        vec[:mid] = True
+                        if est(build(vec)) <= hbm_gb:
+                            hi = mid
+                        else:
+                            lo = mid + 1
+                    k = lo
+                vec = np.zeros(n, bool)
+                vec[:k] = True
+                cands.append(build(vec))
+    return cands
+
+
 def select_schedule(candidates: list, base_gib: float, dims: tuple,
                     hbm_gb: float, host_bw_gibps: float,
                     step_compute_fn, hide_max: float = 1.0,
@@ -226,6 +320,13 @@ def select_schedule(candidates: list, base_gib: float, dims: tuple,
                                    host_bw_gibps)
         fits_hbm = est <= hbm_gb
         hides = feas["offload_hide_ratio"] <= hide_max
+        row_extra = {}
+        if pcfg.schedule == "solver":
+            us = pcfg.unit_schedule
+            row_extra = {"label": us.label,
+                         "wgrad_offload_units": us.offloaded_units,
+                         "wgrad_units_total": us.n_units,
+                         "_pcfg": pcfg}
         rows.append({
             "schedule": pcfg.schedule, "virtual_stages": pcfg.virtual_stages,
             "accum_chunks": pcfg.accum_chunks,
@@ -233,6 +334,7 @@ def select_schedule(candidates: list, base_gib: float, dims: tuple,
             "offload_activations": pcfg.offload_activations,
             "loss_chunks": pcfg.loss_chunks,
             "kernel_ce": pcfg.kernel_ce,
+            **row_extra,
             "est_peak_gib": round(est, 2) + 0.0,  # normalize -0.0
             "host_stash_gib": round(terms["host_gib"], 2) + 0.0,
             "loss_head_gib": round(terms["loss_head_gib"], 2) + 0.0,
@@ -271,13 +373,18 @@ def ce_axis_options(loss_chunks: int, vocab: int, tp: int) -> tuple | None:
     return tuple(sorted(opts))
 
 
-def select_overrides(row: dict) -> str:
+def select_overrides(row: dict, schedule_file: str | None = None) -> str:
     """The winning candidate as `key=value` config overrides — what the
     operator (or the supervisor's layout ladder) pastes onto the launch
-    line to run the chosen schedule."""
+    line to run the chosen schedule. A solver winner additionally needs
+    its emitted sequence file (`--emit-schedule` writes it; without one
+    the override line carries a placeholder to fill in)."""
     parts = [f"pipeline_schedule={row['schedule']}",
              f"virtual_stages={row['virtual_stages']}",
              f"gradient_accumulation_chunks={row['accum_chunks']}"]
+    if row["schedule"] == "solver":
+        parts.append(
+            f"schedule_file={schedule_file or '<path from --emit-schedule>'}")
     if row["offload_wgrad"]:
         parts.append("offload.wgrad_stash=true")
     if row["offload_activations"]:
@@ -287,6 +394,57 @@ def select_overrides(row: dict) -> str:
     if row.get("kernel_ce"):
         parts.append("kernels.ce=pallas")
     return " ".join(parts)
+
+
+def _as_written_pcfg(cfg: dict):
+    """The as-written config's PipelineConfig, rebuilt with the trainer's
+    own builders (preflight() constructs the same thing internally but
+    does not return it) — shared by the --emit-schedule and FAIL-remedies
+    paths in main()."""
+    from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig
+    from llama_pipeline_parallel_tpu.train import (
+        build_manifest,
+        build_model_config,
+        build_pipeline_config,
+    )
+
+    mesh_cfg = MeshConfig(**cfg.get("mesh", {}))
+    model_cfg = build_model_config(cfg["model"])
+    return build_pipeline_config(
+        cfg, mesh_cfg, build_manifest(cfg, model_cfg, mesh_cfg.pp))
+
+
+def stash_remedies(pcfg) -> str:
+    """Remedies for a blown W-stash, DERIVED from emitted sequences instead
+    of a hard-coded list of schedule names: the queue depth comes from the
+    sequence's slot accounting, and each fallback is named with its bubble
+    counted from ITS canonical sequence's idle ticks at this exact shape —
+    so the error text can never drift from what the interpreter runs."""
+    import dataclasses as _dc
+
+    from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+
+    depth = pl.wgrad_queue_peak(pcfg)
+    own_b = pl.bubble_fraction(pcfg)
+    parts = [f"raise gradient_accumulation_chunks (the per-flush W-queue "
+             f"holds {depth} residual units; each doubling halves it)",
+             "tier residuals to host DRAM (offload.wgrad_stash, or a "
+             "solver sequence's per-unit offload vector via --select)"]
+    alts = []
+    for name, v in (("interleaved_1f1b", pcfg.virtual_stages), ("1f1b", 1)):
+        try:
+            alt = _dc.replace(pcfg, schedule=name, virtual_stages=v,
+                              offload_wgrad=False, unit_schedule=None)
+            alts.append((pl.bubble_fraction(alt), name))
+        except ValueError:
+            continue
+    if alts:
+        b, name = min(alts)
+        parts.append(
+            f"fall back to pipeline_schedule: {name} (no W stash; bubble "
+            f"{100 * b:.2f}% vs {100 * own_b:.2f}% here — both counted "
+            f"from the schedules' emitted sequences)")
+    return "; ".join(parts)
 
 
 def preflight(cfg: dict, hbm_gb: float, host_bw_gibps: float = 30.0,
@@ -495,43 +653,51 @@ def preflight(cfg: dict, hbm_gb: float, host_bw_gibps: float = 30.0,
             f"and XLA-CPU over-counts stash buffers past 2^31 elements); "
             f"ring/stash terms re-added analytically at "
             f"M={pcfg_real.num_microbatches}")
+    hbm_slots, host_slots = pl.wgrad_partition(pcfg_real)
     if host_bytes:
         if not anchor_m and not _host_transfers_enabled():
             report["xla_raw_peak_gib"] = round(peak / gib, 2)
         report["host_stash_gib"] = round(host_bytes / gib, 2)
+        wgrad_tier = "wgrad_stash"
+        if (pcfg_real.schedule == "solver" and host_slots
+                and hbm_slots):  # selective vector: name the split
+            wgrad_tier = (f"wgrad_stash"
+                          f"[{pl.wgrad_offloaded_units(pcfg_real)}"
+                          f"/{pcfg_real.unit_schedule.n_units}]")
         report["offload"] = "+".join(
-            n for n, on in (("wgrad_stash", pcfg_real.offload_wgrad),
+            n for n, on in ((wgrad_tier, host_slots > 0),
                             ("activations", pcfg_real.offload_activations))
             if on)
-    if pcfg_real.schedule == "zb1":
-        # The zb1 split backward stashes a (chunk input, ring cotangent)
+    if pl.wgrad_queue_peak(pcfg_real):
+        # The split backward stashes a (chunk input, ring cotangent)
         # residual per queued W unit (docs/SCHEDULES.md "W-stash memory
         # bound"). The explicit term names the schedule's memory tax and
         # sizes the remedies when it blows the headroom (see the FAIL
-        # message in main()): accum_chunks divides the per-flush queue,
-        # offload.wgrad_stash tiers it to host DRAM entirely.
+        # message in main()): accum_chunks divides the per-flush queue;
+        # offload.wgrad_stash (or a solver sequence's per-unit vector)
+        # tiers it to host DRAM. Only the HBM-RESIDENT portion counts
+        # against headroom — a solver vector's host slots already left.
         stash = pl.wgrad_stash_bytes(pcfg_real, *dims)
+        slot_b = dims[0] * dims[1] * dims[2] * dims[3]
+        stash_hbm = 2 * hbm_slots * slot_b
         report["wgrad_queue_depth"] = pl.wgrad_queue_peak(pcfg_real)
         report["wgrad_stash_gib"] = round(stash / gib, 2)
-        if pcfg_real.offload_wgrad:
+        if host_slots and not hbm_slots:
             report["wgrad_stash_verdict"] = (
-                "tiered to host DRAM (offload.wgrad_stash) — HBM holds "
-                "only the in-flight transfer slots")
+                "tiered to host DRAM (offload.wgrad_stash or an all-host "
+                "sequence vector) — HBM holds only the in-flight transfer "
+                "slots")
         else:
-            headroom = hbm_gb - (peak_device_gib - stash / gib)
-            if stash / gib > max(headroom, 0.0):
+            headroom = hbm_gb - (peak_device_gib - stash_hbm / gib)
+            if stash_hbm / gib > max(headroom, 0.0):
                 report["wgrad_stash_verdict"] = (
-                    f"W-stash {report['wgrad_stash_gib']} GiB exceeds the "
-                    f"{round(max(headroom, 0.0), 2)} GiB headroom left by "
-                    f"the rest of the step — raise "
-                    f"gradient_accumulation_chunks (halves the per-flush "
-                    f"W-queue per doubling), enable offload.wgrad_stash "
-                    f"(tiers the queue to host DRAM behind overlapped "
-                    f"transfers), or fall back to pipeline_schedule: "
-                    f"interleaved_1f1b")
+                    f"HBM-resident W-stash {round(stash_hbm / gib, 2)} GiB "
+                    f"exceeds the {round(max(headroom, 0.0), 2)} GiB "
+                    f"headroom left by the rest of the step — "
+                    f"{stash_remedies(pcfg_real)}")
             else:
                 report["wgrad_stash_verdict"] = "fits within headroom"
-    if pcfg_real.offload_wgrad or pcfg_real.offload_activations:
+    if pcfg_real.offload_activations or host_slots:
         # Host-bandwidth feasibility (the PipeOffload bound): the stash
         # traffic must stream behind the step's compute, or the offload
         # trades an OOM for a stall — rejected HERE, analytically, not
@@ -777,6 +943,13 @@ def main(argv: list[str] | None = None) -> None:
                         "bound and print the analytically chosen config "
                         "(OptPipe-style selection; docs/SCHEDULES.md "
                         "'Host offload')")
+    p.add_argument("--emit-schedule", default=None, metavar="PATH",
+                   help="dump the selected unit sequence (the --select "
+                        "winner's, else the as-written config's canonical "
+                        "re-emission) as JSON to PATH and print the "
+                        "per-stage ASCII timeline — debug a refused or "
+                        "surprising schedule without a TPU; the file feeds "
+                        "pipeline_schedule: solver + schedule_file")
     p.add_argument("--host-bw-gibps", type=float, default=30.0,
                    help="assumed host-link bandwidth, GiB/s, for the "
                         "offload feasibility bound (measure the real one "
@@ -849,6 +1022,10 @@ def main(argv: list[str] | None = None) -> None:
             print(f"  {k}: {v}")
     if args.select:
         _print_selection(cfg, report, args)
+    elif args.emit_schedule:
+        _emit_schedule(args.emit_schedule, None, None,
+                       int((cfg.get("mesh") or {}).get("pp", 1)),
+                       _as_written_pcfg(cfg))
     if not report["fits"]:
         print(f"preflight FAIL: per-device peak {report['per_device_peak_gib']} GiB "
               f"exceeds the {args.hbm_gb} GiB budget"
@@ -856,14 +1033,13 @@ def main(argv: list[str] | None = None) -> None:
               or report["offload_hide_ratio"] <= args.hide_ratio_max else
               f"preflight FAIL: {report['offload_bw_verdict']}")
         if "wgrad_queue_depth" in report and not report.get("offload"):
-            # actionable zb1 guidance: the W-stash is the schedule's own
-            # memory tax, with two dials and a fallback (docs/SCHEDULES.md)
-            print(f"  zb1 W-stash: {report['wgrad_stash_gib']} GiB across "
-                  f"{report['wgrad_queue_depth']} queued units — raise "
-                  f"gradient_accumulation_chunks to shrink the per-flush "
-                  f"W-queue, enable offload.wgrad_stash to tier it to host "
-                  f"DRAM, or fall back to pipeline_schedule: "
-                  f"interleaved_1f1b")
+            # actionable split-backward guidance: the W-stash is the
+            # schedule's own memory tax; the remedies (and the fallback's
+            # bubble) are DERIVED from the emitted sequences at this exact
+            # shape, not hard-coded schedule names (docs/SCHEDULES.md)
+            print(f"  W-stash: {report['wgrad_stash_gib']} GiB across "
+                  f"{report['wgrad_queue_depth']} queued units — "
+                  f"{stash_remedies(_as_written_pcfg(cfg))}")
         sys.exit(1)
     print("preflight OK")
 
@@ -907,16 +1083,30 @@ def _print_selection(cfg: dict, report: dict, args) -> None:
         model_cfg, mesh_cfg, c, mb_rows, seq, args.mfu, args.chip_flops)
     ce_axis = ce_axis_options(pcfg.loss_chunks, model_cfg.vocab_size,
                               mesh_cfg.tp)
+    candidates = enumerate_candidates(mesh_cfg.pp, pcfg.num_microbatches,
+                                      model_cfg.num_hidden_layers,
+                                      ce_options=ce_axis)
+    # the solver lane: list-scheduled sequences with budget-sized per-unit
+    # offload vectors, scored in the SAME pass under the same constraints
+    # (incl. the dense loss-head term solver rows are charged — they carry
+    # the as-written head, never a ce override)
+    solver_head = 0.0
+    if vocab:
+        import dataclasses as _dc
+
+        solver_head = pl.loss_head_bytes(
+            _dc.replace(pcfg, loss_chunks=1, kernel_ce=False),
+            *dims[:3], vocab) / (1 << 30)
+    candidates += solver_candidates(mesh_cfg.pp, pcfg.num_microbatches,
+                                    model_cfg.num_hidden_layers, base, dims,
+                                    args.hbm_gb, head_gib=solver_head)
     winner, rows = select_schedule(
-        enumerate_candidates(mesh_cfg.pp, pcfg.num_microbatches,
-                             model_cfg.num_hidden_layers,
-                             ce_options=ce_axis),
-        base, dims, args.hbm_gb, args.host_bw_gibps, compute_fn,
+        candidates, base, dims, args.hbm_gb, args.host_bw_gibps, compute_fn,
         hide_max=args.hide_ratio_max, vocab=vocab)
     print(f"schedule selection ({len(rows)} candidates; base "
           f"{round(base, 2)} GiB + per-candidate ring/stash/loss-head; "
           f"bw {args.host_bw_gibps} GiB/s, mfu {args.mfu}):")
-    print(f"  {'schedule':<17} {'v':>2} {'c':>2} {'offload':<12} "
+    print(f"  {'schedule':<17} {'v':>2} {'c':>2} {'offload':<14} "
           f"{'ce':<10} {'peak GiB':>9} {'host GiB':>9} {'head GiB':>9} "
           f"{'bubble%':>8} {'hide':>6}  verdict")
     for r in sorted(rows, key=lambda r: (not r["feasible"],
@@ -925,11 +1115,17 @@ def _print_selection(cfg: dict, report: dict, args) -> None:
         off = "+".join(n for n, on in (("wgrad", r["offload_wgrad"]),
                                        ("acts", r["offload_activations"]))
                        if on) or "-"
+        if r.get("wgrad_offload_units"):
+            off = (f"wgrad[{r['wgrad_offload_units']}"
+                   f"/{r['wgrad_units_total']}]")
+        sched_name = r["schedule"]
+        if r.get("label"):
+            sched_name = r["label"]
         ce = (f"{'pallas' if r['kernel_ce'] else 'xla'}/"
               f"{r['loss_chunks']}")
         mark = "*" if r is winner else " "
-        print(f" {mark}{r['schedule']:<17} {r['virtual_stages']:>2} "
-              f"{r['accum_chunks']:>2} {off:<12} {ce:<10} "
+        print(f" {mark}{sched_name:<17} {r['virtual_stages']:>2} "
+              f"{r['accum_chunks']:>2} {off:<14} {ce:<10} "
               f"{r['est_peak_gib']:>9} {r['host_stash_gib']:>9} "
               f"{r['loss_head_gib']:>9} "
               f"{100 * r['bubble_fraction']:>8.2f} {r['hide_ratio']:>6} "
@@ -937,11 +1133,69 @@ def _print_selection(cfg: dict, report: dict, args) -> None:
     if winner is None:
         print("selection: NO feasible candidate — grow the mesh (tp/pp) or "
               "shrink the batch shape")
-    else:
-        print(f"selected: {select_overrides(winner)}  "
-              f"(est peak {winner['est_peak_gib']} GiB, bubble "
-              f"{100 * winner['bubble_fraction']:.2f}%, host stash "
-              f"{winner['host_stash_gib']} GiB)")
+        if getattr(args, "emit_schedule", None):
+            # the debug-a-refused-schedule case the flag exists for: emit
+            # the as-written config's canonical sequence so the operator
+            # can read the timeline even though nothing fit
+            _emit_schedule(args.emit_schedule, None, None, mesh_cfg.pp, pcfg)
+        return
+    emitted = None
+    if getattr(args, "emit_schedule", None):
+        emitted = _emit_schedule(args.emit_schedule, winner.get("_pcfg"),
+                                 winner, mesh_cfg.pp, pcfg)
+    print(f"selected: {select_overrides(winner, schedule_file=emitted)}  "
+          f"(est peak {winner['est_peak_gib']} GiB, bubble "
+          f"{100 * winner['bubble_fraction']:.2f}%, host stash "
+          f"{winner['host_stash_gib']} GiB)")
+
+
+def _emit_schedule(path: str, winner_pcfg, row: dict | None, pp: int,
+                   as_written_pcfg=None) -> str:
+    """`--emit-schedule <path>`: serialize the relevant unit sequence
+    (the --select winner's, else the as-written config's canonical
+    re-emission) as JSON and print the compact per-stage ASCII timeline —
+    so a refused or surprising schedule is debuggable without a TPU."""
+    from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+    from llama_pipeline_parallel_tpu.parallel import schedule as usched
+
+    import dataclasses as _dc
+
+    pcfg = winner_pcfg
+    if pcfg is None and row is None and as_written_pcfg is not None \
+            and as_written_pcfg.schedule == "gpipe":
+        print("--emit-schedule: gpipe has no unit sequence (its backward "
+              "is AD of the forward scan) — nothing emitted")
+        return path
+    if pcfg is None and row is not None:
+        # a canonical winner: rebuild its pcfg from the row (the winner's
+        # grid shares the as-written config's total microbatch count)
+        if as_written_pcfg is None:
+            raise ValueError("_emit_schedule needs the as-written pcfg to "
+                             "size a canonical winner's flush")
+        pcfg = pl.PipelineConfig(
+            num_stages=pp,
+            num_microbatches=as_written_pcfg.num_microbatches,
+            schedule=row["schedule"], virtual_stages=row["virtual_stages"],
+            accum_chunks=row["accum_chunks"],
+            offload_wgrad=row["offload_wgrad"],
+            offload_activations=row["offload_activations"])
+    if pcfg is None:
+        pcfg = as_written_pcfg
+    flush_pcfg = _dc.replace(
+        pcfg, num_microbatches=pcfg.num_microbatches // pcfg.accum_chunks,
+        accum_chunks=1)
+    seq = (flush_pcfg.unit_schedule if flush_pcfg.schedule == "solver"
+           else usched.canonical_schedule(
+               flush_pcfg.schedule, flush_pcfg.num_microbatches,
+               flush_pcfg.num_stages, flush_pcfg.virtual_stages,
+               offload_wgrad=flush_pcfg.offload_wgrad))
+    with open(path, "w") as fh:
+        fh.write(usched.to_json(seq))
+    idle, wall = usched.bubble_stats(seq)
+    print(f"emitted unit sequence -> {path} ({seq.num_ticks} ticks, "
+          f"{idle}/{wall} idle units = {idle / wall:.4f} bubble per flush)")
+    print(usched.ascii_timeline(seq))
+    return path
 
 
 if __name__ == "__main__":
