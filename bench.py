@@ -478,6 +478,92 @@ def main() -> None:
                     print(f"bench schedule row {sched} pp={pp_s} v={v_s} "
                           f"failed: {e!r}", file=sys.stderr, flush=True)
 
+        # Cost-model auto-layout rows (BENCH_LAYOUT=0 skips): the generated
+        # ladder's top rungs (tools/preflight.py layout_frontier — the
+        # (pp, tp, dp, sp) frontier at the chips this process can see, the
+        # same lane `--select --emit-ladder` walks), each measured over the
+        # SAME global batch with the ANALYTIC step-time score emitted NEXT
+        # to the measured step time — so one reachable-TPU run records the
+        # whole model-vs-measured frontier in one pass (the standing
+        # no-live-perf-number gap). Behind the same fail-fast probe as
+        # everything else; on the CPU virtual mesh the absolute numbers are
+        # meaningless but the rows prove the machinery end-to-end.
+        if os.environ.get("BENCH_LAYOUT", "1") != "0":
+            try:
+                sys.path.insert(0, os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "tools"))
+                import preflight as _pf
+
+                n_dev = jax.device_count()
+                mb_l = 1
+                m_l = int(os.environ.get("BENCH_SCHED_MICROBATCHES", "8"))
+                g_l = mb_l * m_l * n_dev  # examples/step, rung-invariant
+                # anchor the memory model on its own pp1 estimate (no
+                # compile here — the budget only prunes absurd layouts; the
+                # point of these rows is score-vs-measured, and
+                # vocab_enabled=False keeps every rung on the as-written
+                # loss head so the layout axis is the only variable)
+                base_aw = _pf.layout_device_gib(cfg, 1, 1, 1)
+                _, lrows = _pf.layout_frontier(
+                    cfg, n_dev, mb_l, seq, g_l, base_aw, (1, 1, 1, 1),
+                    float(os.environ.get("BENCH_LAYOUT_HBM_GB", "95")),
+                    chip_flops=peak, vocab_enabled=False, solver_lane=False)
+                top = [r for r in lrows if r["feasible"]][:3]
+                if not top:
+                    print("bench layout rows skipped: no feasible layout "
+                          f"at {n_dev} device(s)", file=sys.stderr, flush=True)
+                for r in top:
+                    s = r["sched"]
+                    try:
+                        lay_mesh = make_mesh(MeshConfig(
+                            pp=r["pp"], tp=r["tp"], dp=r["dp"], sp=r["sp"]))
+                        man_l = StageManifest(
+                            num_layers=cfg.num_hidden_layers,
+                            num_stages=r["pp"],
+                            layer_counts=(tuple(r["layer_counts"])
+                                          if r["layer_counts"] else None),
+                            virtual_stages=s["virtual_stages"])
+                        stacked_l = pl.stack_stages(canonical, man_l)
+                        pcfg_l = pl.PipelineConfig(
+                            num_stages=r["pp"],
+                            num_microbatches=r["microbatches"],
+                            schedule=s["schedule"],
+                            virtual_stages=s["virtual_stages"],
+                            accum_chunks=s["accum_chunks"],
+                            offload_wgrad=s["offload_wgrad"],
+                            offload_activations=s["offload_activations"],
+                            layer_counts=(None if man_l.is_even
+                                          else man_l.stage_layer_counts))
+                        fn = jax.jit(pl.make_pipeline_loss_and_grad(
+                            lay_mesh, cfg, pcfg_l, stacked_l))
+                        lbatch = make_batch(g_l)
+                        float(fn(stacked_l, lbatch)[0])  # compile
+                        t0 = time.perf_counter()
+                        for _ in range(n_steps):
+                            last = float(fn(stacked_l, lbatch)[0])
+                        dt = (time.perf_counter() - t0) / n_steps
+                        if not np.isfinite(last):
+                            raise ValueError(f"non-finite loss {last}")
+                        results[f"extra:layout-{r['layout']}"] = {
+                            "dt": dt, "tokens_per_step": g_l * seq,
+                            "headline": False, "detail": {
+                                "layout": r["layout"],
+                                "microbatches": r["microbatches"],
+                                "layer_counts": r["layer_counts"],
+                                "schedule": s["schedule"],
+                                "virtual_stages": s["virtual_stages"],
+                                "accum_chunks": s["accum_chunks"],
+                                "bubble_fraction_analytic":
+                                    r["bubble_fraction"],
+                                "score_s_model": r["score_s"],
+                                "est_peak_gib_model": r["est_peak_gib"]}}
+                    except Exception as e:
+                        print(f"bench layout row {r['layout']} failed: "
+                              f"{e!r}", file=sys.stderr, flush=True)
+            except Exception as e:
+                print(f"bench layout rows failed: {e!r}", file=sys.stderr,
+                      flush=True)
+
         # Host-stash offload rows (BENCH_OFFLOAD=0 skips): the measured
         # D2H/H2D host-link bandwidth (the number tools/preflight.py's
         # --host-bw-gibps feasibility assumption should be fed) and the

@@ -250,10 +250,22 @@ class PipelineConfig:
                 f"schedule=interleaved_1f1b, zb1, or solver "
                 f"(got {self.schedule!r})")
         if self.schedule in ("interleaved_1f1b", "zb1", "solver"):
-            if self.layer_counts is not None and len(set(self.layer_counts)) != 1:
+            uneven = (self.layer_counts is not None
+                      and len(set(self.layer_counts)) != 1)
+            # zb1/solver at v=1 run UNEQUAL partitions through the unit
+            # interpreter — the padded stacked layout and per-chunk vjps are
+            # layer-count-agnostic, so "unequal stages just change the unit
+            # sequence" (ROADMAP item 3). The round-robin chunk layout
+            # (interleaved_1f1b, or any v>1) has no uneven form.
+            if uneven and (self.schedule == "interleaved_1f1b"
+                           or self.virtual_stages > 1):
                 raise ValueError(
-                    f"{self.schedule} requires an even stage partition; "
-                    f"got layer_counts={self.layer_counts}")
+                    f"{self.schedule} with virtual_stages="
+                    f"{self.virtual_stages} requires an even stage "
+                    f"partition (the round-robin chunk layout has no "
+                    f"uneven form); got layer_counts={self.layer_counts} — "
+                    f"unequal stages run under zb1/solver at "
+                    f"virtual_stages: 1, or the flat schedules")
             m_flush = self.num_microbatches // self.accum_chunks
             if (self.schedule != "solver" and self.virtual_stages > 1
                     and m_flush % self.num_stages):
@@ -284,6 +296,17 @@ class PipelineConfig:
                 raise ValueError(
                     f"unit sequence does not fit this run: "
                     f"{'; '.join(mismatches)}")
+            if us.stage_costs is not None:
+                mine = (tuple(self.layer_counts) if self.layer_counts
+                        is not None else None)
+                theirs = tuple(us.stage_costs)
+                if len(set(theirs)) != 1 and theirs != mine:
+                    raise ValueError(
+                        f"unit sequence was generated for stage layer "
+                        f"counts {theirs} but this run partitions as "
+                        f"{mine or 'even'} — re-emit the sequence for "
+                        f"this partition (tools/preflight.py "
+                        f"--emit-schedule)")
             if self.offload_wgrad:
                 raise ValueError(
                     "schedule: solver carries its own per-unit offload "
@@ -1113,18 +1136,31 @@ def _unit_schedule_for(pcfg: PipelineConfig):
     hand-written phase scans). Callers pass a pcfg whose num_microbatches
     is already the per-flush count (accum_chunks=1)."""
     if pcfg.schedule == "solver":
-        return pcfg.unit_schedule
+        us = pcfg.unit_schedule
+        if (us.stage_costs is None or len(set(us.stage_costs)) == 1) \
+                and pcfg.layer_counts is not None \
+                and len(set(pcfg.layer_counts)) != 1:
+            # a costless (or uniform-cost — same accounting) sequence run
+            # on an unequal partition: attach the run's layer counts so
+            # the bubble accounting stays honest (unit placement is
+            # cost-independent)
+            us = dataclasses.replace(us, stage_costs=tuple(pcfg.layer_counts))
+        return us
+    counts = (tuple(pcfg.layer_counts)
+              if pcfg.layer_counts is not None
+              and len(set(pcfg.layer_counts)) != 1 else None)
     return _canonical_cached(pcfg.schedule,
                              pcfg.num_microbatches // pcfg.accum_chunks,
                              pcfg.num_stages, pcfg.virtual_stages,
-                             pcfg.offload_wgrad)
+                             pcfg.offload_wgrad, counts)
 
 
 @functools.lru_cache(maxsize=64)
 def _canonical_cached(schedule: str, m: int, s: int, v: int,
-                      offload_wgrad: bool):
+                      offload_wgrad: bool, stage_costs: tuple | None = None):
     return usched.canonical_schedule(schedule, m, s, v,
-                                     offload_wgrad=offload_wgrad)
+                                     offload_wgrad=offload_wgrad,
+                                     stage_costs=stage_costs)
 
 
 def _pipeline_units_local(

@@ -102,6 +102,16 @@ class UnitSchedule:
     wq_hbm_slots: int
     wq_host_slots: int
     label: str = ""
+    # Per-stage LAYER counts for UNEQUAL partitions (None = even): a unit's
+    # cost on stage s is stage_costs[s] layer-units instead of 1, so the
+    # lockstep wall charges every tick at the SLOWEST stage's cost while a
+    # lighter stage's unit does proportionally less useful work —
+    # bubble_stats counts both the fill/drain idle AND the per-tick
+    # imbalance (SkipPipe/MPMD-PP's unequal-stage cost model, PAPERS.md).
+    # Ring transport and liveness rules are layer-count-independent (a
+    # stage's chunk is opaque to the ring), so the validator only checks
+    # shape. v=1 only: the round-robin chunk layout has no uneven form.
+    stage_costs: tuple | None = None
 
     @property
     def n_units(self) -> int:
@@ -149,13 +159,28 @@ def _grids(num_ticks: int, num_stages: int):
             np.full(shape, -1, np.int32))
 
 
-def generate_1f1b(m: int, s: int) -> UnitSchedule:
+def _norm_costs(stage_costs, s: int):
+    """Validate/normalize a per-stage layer-count vector at generation time
+    (None passes through: even partitions carry no cost vector)."""
+    if stage_costs is None:
+        return None
+    costs = tuple(int(c) for c in stage_costs)
+    if len(costs) != s:
+        raise ScheduleError(f"stage_costs has {len(costs)} entries for "
+                            f"{s} stages")
+    if any(c < 1 for c in costs):
+        raise ScheduleError(f"every stage needs cost >= 1 layer, got {costs}")
+    return costs
+
+
+def generate_1f1b(m: int, s: int, stage_costs=None) -> UnitSchedule:
     """The flat 1F1B grid the deleted `_pipeline_1f1b_local` scanned: one
     segment of m + 2(S-1) ticks, EVERY tick structurally F+B with both
     ring directions (warmup/drain slots are -1 = masked, exactly as the
     old single scan masked them), forward unit t-s / backward unit
     t-(2S-2-s). At S=1 the forward half never existed (the fused backward
     re-embeds under its stage-0 cond), so the grid is B-only."""
+    costs = _norm_costs(stage_costs, s)
     if s == 1:
         f, b, w = _grids(m, 1)
         b[:, 0] = np.arange(m)
@@ -166,7 +191,8 @@ def generate_1f1b(m: int, s: int) -> UnitSchedule:
             has_f=t.copy(), has_b=~t, has_w=t.copy(),
             ring_fwd=t.copy(), ring_bwd=t.copy(), ring_slots=1,
             offload_units=np.zeros(0, bool), wq_slot=np.zeros(0, np.int32),
-            wq_hbm_slots=0, wq_host_slots=0, label="1f1b")
+            wq_hbm_slots=0, wq_host_slots=0, label="1f1b",
+            stage_costs=costs)
     num_ticks = m + 2 * (s - 1)
     f, b, w = _grids(num_ticks, s)
     t_idx = np.arange(num_ticks)[:, None]
@@ -183,14 +209,15 @@ def generate_1f1b(m: int, s: int) -> UnitSchedule:
         ring_fwd=on.copy(), ring_bwd=on.copy(),
         ring_slots=min(2 * s - 1, m),
         offload_units=np.zeros(0, bool), wq_slot=np.zeros(0, np.int32),
-        wq_hbm_slots=0, wq_host_slots=0, label="1f1b")
+        wq_hbm_slots=0, wq_host_slots=0, label="1f1b", stage_costs=costs)
 
 
 def generate_interleaved(m: int, s: int, v: int = 1,
                          split_backward: bool = False,
                          offload_units=None,
                          w_placement: str = "trailing",
-                         label: str | None = None) -> UnitSchedule:
+                         label: str | None = None,
+                         stage_costs=None) -> UnitSchedule:
     """The phased interleaved grid the deleted
     `_pipeline_interleaved_1f1b_local` ran: vS-1 forward-only warmup
     ticks, steady F+B ticks, vS-1 backward-only drain ticks — forward
@@ -208,6 +235,11 @@ def generate_interleaved(m: int, s: int, v: int = 1,
 
     `offload_units`: per-unit host-tier decision vector (None = all-HBM;
     pass np.ones for the legacy offload.wgrad_stash behavior)."""
+    costs = _norm_costs(stage_costs, s)
+    if v > 1 and costs is not None and len(set(costs)) != 1:
+        raise ScheduleError(
+            f"unequal stage_costs={costs} require v=1: the round-robin "
+            f"chunk layout has no uneven form (got v={v})")
     if v > 1 and m % s:
         raise ScheduleError(
             f"interleaved sequences need m divisible by num_stages at "
@@ -279,7 +311,8 @@ def generate_interleaved(m: int, s: int, v: int = 1,
         ring_fwd=ring_fwd, ring_bwd=ring_bwd,
         ring_slots=min(2 * v * s - 1, n_units),
         offload_units=off, wq_slot=wq_slot,
-        wq_hbm_slots=hbm_n, wq_host_slots=host_n, label=label)
+        wq_hbm_slots=hbm_n, wq_host_slots=host_n, label=label,
+        stage_costs=costs)
 
 
 def _assign_wq_slots(s: int, v: int, n_units: int, b_grid, w_grid, off):
@@ -289,16 +322,13 @@ def _assign_wq_slots(s: int, v: int, n_units: int, b_grid, w_grid, off):
     trailing-W schedules get the identity map (nothing retires before the
     drain); drain-interleaved W frees the earliest units while late B
     units are still pushing, compressing the resident queue."""
-    push = np.full(n_units, -1, np.int64)
+    push = np.full(n_units, np.iinfo(np.int64).max, np.int64)
     pop = np.full(n_units, -1, np.int64)
-    for t in range(b_grid.shape[0]):
-        for st in range(s):
-            g = b_grid[t, st]
-            if g >= 0 and (push[g] < 0 or t < push[g]):
-                push[g] = t
-            g = w_grid[t, st]
-            if g >= 0 and t > pop[g]:
-                pop[g] = t
+    t_pos, s_pos = np.nonzero(b_grid >= 0)
+    np.minimum.at(push, b_grid[t_pos, s_pos], t_pos)
+    t_pos, s_pos = np.nonzero(w_grid >= 0)
+    np.maximum.at(pop, w_grid[t_pos, s_pos], t_pos)
+    push[push == np.iinfo(np.int64).max] = -1
     slots = np.zeros(n_units, np.int32)
     counts = {}
     for dest in (False, True):
@@ -324,17 +354,21 @@ def _assign_wq_slots(s: int, v: int, n_units: int, b_grid, w_grid, off):
 
 
 def canonical_schedule(schedule: str, m: int, s: int, v: int = 1,
-                       offload_wgrad: bool = False) -> UnitSchedule:
+                       offload_wgrad: bool = False,
+                       stage_costs=None) -> UnitSchedule:
     """The named schedule's canonical per-flush sequence — the generator
-    that re-emits the three deleted hand-written scans as data."""
+    that re-emits the three deleted hand-written scans as data.
+    `stage_costs`: per-stage layer counts for an UNEQUAL partition (the
+    unit placement is identical — only the cost accounting changes)."""
     if schedule == "1f1b":
-        return generate_1f1b(m, s)
+        return generate_1f1b(m, s, stage_costs=stage_costs)
     if schedule == "interleaved_1f1b":
-        return generate_interleaved(m, s, v)
+        return generate_interleaved(m, s, v, stage_costs=stage_costs)
     if schedule == "zb1":
         off = np.ones(m * v, bool) if offload_wgrad else None
         return generate_interleaved(m, s, v, split_backward=True,
-                                    offload_units=off)
+                                    offload_units=off,
+                                    stage_costs=stage_costs)
     raise ScheduleError(f"no canonical sequence for schedule {schedule!r}")
 
 
@@ -348,13 +382,33 @@ def bubble_stats(us: UnitSchedule) -> tuple[int, int]:
     present half (the lockstep scan runs masked slots and discards them);
     useful work counts only the real (non -1) units. bubble =
     idle / wall — the generic form of the three deleted closed formulas,
-    now derived by COUNTING the emitted sequence's idle ticks."""
+    now derived by COUNTING the emitted sequence's idle ticks.
+
+    With UNEQUAL `stage_costs` the accounting goes to LAYER units: a tick's
+    wall cost is max(stage_costs) per structurally present half (the
+    lockstep ppermute syncs every stage to the slowest one), while stage
+    s's live unit contributes only stage_costs[s] useful layer-units — so
+    the bubble counts fill/drain idle AND per-tick imbalance in one number.
+    Even partitions (stage_costs None or uniform k) scale idle and wall by
+    the same k, reducing to the identical rational: the floats stay
+    bit-identical to the uncosted accounting."""
     bc = _cost_b(us.split_backward)
+    costs = us.stage_costs
+    if costs is None or len(set(costs)) == 1:
+        wall = int(us.has_f.sum() * COST_F + us.has_b.sum() * bc
+                   + us.has_w.sum() * COST_W)
+        useful = int((us.f_unit >= 0).sum() * COST_F
+                     + (us.b_unit >= 0).sum() * bc
+                     + (us.w_unit >= 0).sum() * COST_W)
+        total = us.num_stages * wall
+        return total - useful, total
+    c = np.asarray(costs, np.int64)
+    cmax = int(c.max())
     wall = int(us.has_f.sum() * COST_F + us.has_b.sum() * bc
-               + us.has_w.sum() * COST_W)
-    useful = int((us.f_unit >= 0).sum() * COST_F
-                 + (us.b_unit >= 0).sum() * bc
-                 + (us.w_unit >= 0).sum() * COST_W)
+               + us.has_w.sum() * COST_W) * cmax
+    useful = int(((us.f_unit >= 0) * c[None, :]).sum() * COST_F
+                 + ((us.b_unit >= 0) * c[None, :]).sum() * bc
+                 + ((us.w_unit >= 0) * c[None, :]).sum() * COST_W)
     total = us.num_stages * wall
     return total - useful, total
 
@@ -416,6 +470,12 @@ def validate(us: UnitSchedule) -> None:
     if us.split_backward and us.wq_slot.size and int(us.wq_slot.min()) < 0:
         raise ScheduleError("negative wq_slot entries (the interpreter's "
                            "clip would silently alias residual slots)")
+    if us.stage_costs is not None:
+        _norm_costs(us.stage_costs, s)  # shape/positivity
+        if v > 1 and len(set(us.stage_costs)) != 1:
+            raise ScheduleError(
+                f"unequal stage_costs={tuple(us.stage_costs)} require v=1: "
+                f"the round-robin chunk layout has no uneven form")
 
     # per-stage unit streams + tick-of-unit maps (vectorized: the validator
     # runs inside every solver-candidate construction, so it must stay
@@ -610,6 +670,8 @@ def to_json(us: UnitSchedule) -> str:
         "wq_slot": [int(x) for x in us.wq_slot],
         "ticks": ticks, "stages": stages,
     }
+    if us.stage_costs is not None:
+        doc["stage_costs"] = [int(c) for c in us.stage_costs]
     return json.dumps(doc, indent=1)
 
 
@@ -654,7 +716,9 @@ def from_json(text: str) -> UnitSchedule:
         wq_slot=np.array(doc["wq_slot"], np.int32),
         wq_hbm_slots=int(doc["wq_hbm_slots"]),
         wq_host_slots=int(doc["wq_host_slots"]),
-        label=str(doc.get("label", "")))
+        label=str(doc.get("label", "")),
+        stage_costs=(tuple(int(c) for c in doc["stage_costs"])
+                     if doc.get("stage_costs") is not None else None))
     validate(us)
     return us
 
@@ -686,7 +750,10 @@ def ascii_timeline(us: UnitSchedule, max_ticks: int = 64) -> str:
              f"split_backward={us.split_backward} "
              f"ring_slots={us.ring_slots} "
              f"wq=[hbm {us.wq_hbm_slots} | host {us.wq_host_slots}] "
-             f"bubble={analytic_bubble(us):.4f}"]
+             + (f"layers/stage={list(us.stage_costs)} "
+                if us.stage_costs is not None
+                and len(set(us.stage_costs)) != 1 else "")
+             + f"bubble={analytic_bubble(us):.4f}"]
     ring = " ".join(
         (("f" if us.ring_fwd[t] else " ") + ("b" if us.ring_bwd[t] else " "))
         .ljust(width) for t in range(t_show))
@@ -723,7 +790,7 @@ def with_offload(us: UnitSchedule, offload_units) -> UnitSchedule:
 
 def list_schedule(m: int, s: int, v: int = 1, split_backward: bool = True,
                   w_placement: str = "drain",
-                  offload_units=None) -> UnitSchedule:
+                  offload_units=None, stage_costs=None) -> UnitSchedule:
     """The list-scheduling heuristic's entry point: greedily place units
     on the lockstep tick grid in dependency order — which, under the
     lockstep cost model (every stage pays each structurally present
@@ -742,6 +809,7 @@ def list_schedule(m: int, s: int, v: int = 1, split_backward: bool = True,
                               offload_units=offload_units if split_backward
                               else None,
                               label=f"solver/{w_placement}-w"
-                              if split_backward else "solver/fused")
+                              if split_backward else "solver/fused",
+                              stage_costs=stage_costs)
     validate(us)
     return us

@@ -681,7 +681,7 @@ def _run_training(cfg: dict) -> dict:
             "Mosaic-compiled" if jax.default_backend() == "tpu"
             else "interpret mode — parity semantics, no kernel speedup "
                  "off-TPU")
-    topology = _topology_meta(mesh, pcfg)
+    topology = _topology_meta(mesh, pcfg, manifest)
     # Numerics observatory (docs/OBSERVABILITY.md "Numerics"): per-stage
     # training-dynamics stats computed in-graph, anomaly detection + the
     # numerics.jsonl stream on the host. On by default — the in-graph
@@ -889,17 +889,28 @@ def _run_training(cfg: dict) -> dict:
                       output_dir)
 
 
-def _topology_meta(mesh, pcfg: "pl.PipelineConfig") -> dict:
+def _topology_meta(mesh, pcfg: "pl.PipelineConfig",
+                   manifest: StageManifest | None = None) -> dict:
     """The run's topology, recorded in every checkpoint's meta.json and in
     health.json — the source half of the elastic-restore contract
     (docs/RESILIENCE.md "Elastic resume"): a later incarnation on a
-    different mesh reads it to explain (and log) what changed."""
+    different mesh reads it to explain (and log) what changed.
+
+    `layer_counts` names the stage PARTITION — "even/10" or the explicit
+    per-stage list — so a partition change (e.g. (4,4,4,1) -> even/2 from a
+    generated-ladder resize) is logged like a pp/dp/tp change instead of
+    silently resharding through the canonical layout."""
     mc = MeshConfig(pp=mesh.shape["pp"], dp=mesh.shape["dp"],
                     tp=mesh.shape["tp"], sp=mesh.shape["sp"])
-    return {"pp": mc.pp, "dp": mc.dp, "tp": mc.tp, "sp": mc.sp,
-            "layout": mc.describe(),
-            "schedule": pcfg.schedule, "virtual_stages": pcfg.virtual_stages,
-            "process_count": jax.process_count()}
+    out = {"pp": mc.pp, "dp": mc.dp, "tp": mc.tp, "sp": mc.sp,
+           "layout": mc.describe(),
+           "schedule": pcfg.schedule, "virtual_stages": pcfg.virtual_stages,
+           "process_count": jax.process_count()}
+    if manifest is not None:
+        out["layer_counts"] = (
+            f"even/{manifest.stage_layer_counts[0]}" if manifest.is_even
+            else list(manifest.stage_layer_counts))
+    return out
 
 
 def _data_state(step: int, loader: DataLoader, dataset_len: int,
@@ -987,18 +998,26 @@ def _note_topology_change(mgr: CheckpointManager, step: int,
         return
     if not source:
         return  # pre-elastic checkpoint: nothing recorded
-    changed = sorted(k for k in ("pp", "dp", "tp", "sp", "schedule",
-                                 "virtual_stages")
-                     if source.get(k) != current.get(k))
+    keys = ["pp", "dp", "tp", "sp", "schedule", "virtual_stages"]
+    if "layer_counts" in source:
+        # the stage PARTITION is restore-relevant like a topology axis (a
+        # (4,4,4,1) -> even/2 ladder resize reshards every layer leaf);
+        # compared only when the source recorded it, so pre-partition-aware
+        # checkpoints don't flag a phantom change on every resume
+        keys.append("layer_counts")
+    changed = sorted(k for k in keys if source.get(k) != current.get(k))
     if changed:
         logger.warning(
             "elastic restore: checkpoint-%d was written at %s "
-            "(schedule=%s, v=%s); restoring onto %s (schedule=%s, v=%s) — "
+            "(schedule=%s, v=%s, layer_counts=%s); restoring onto %s "
+            "(schedule=%s, v=%s, layer_counts=%s) — "
             "changed: %s. Keep the global batch unchanged for sample-exact "
             "data continuity (docs/RESILIENCE.md)",
             step, source.get("layout"), source.get("schedule"),
-            source.get("virtual_stages"), current.get("layout"),
-            current.get("schedule"), current.get("virtual_stages"), changed)
+            source.get("virtual_stages"), source.get("layer_counts"),
+            current.get("layout"), current.get("schedule"),
+            current.get("virtual_stages"), current.get("layer_counts"),
+            changed)
     else:
         logger.info("resume topology matches checkpoint-%d (%s)", step,
                     current.get("layout"))
@@ -1502,7 +1521,7 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
         host.load_state_dict({"m": m, "v": v, "step_count": step_count})
         return resume
 
-    topology = _topology_meta(mesh, pcfg)
+    topology = _topology_meta(mesh, pcfg, manifest)
     restored = (_restore_with_fallback(mgr, _restore_offload)
                 if cfg.get("resume", True) else None)
     if restored is not None:

@@ -86,18 +86,28 @@ def _resolve(node: Any, root: Any, seen: tuple[str, ...] = ()) -> Any:
     return node
 
 
-def load_config(path: str, overrides: list[str] | None = None) -> dict:
-    """Load YAML, apply `a.b=c` overrides, resolve `${}` interpolations."""
-    with open(path) as f:
-        cfg = yaml.safe_load(f) or {}
-    if not isinstance(cfg, dict):
-        raise ValueError(f"top-level config must be a mapping, got {type(cfg)}")
+def apply_overrides(cfg: dict, overrides: list[str] | None) -> dict:
+    """Apply `a.b=c` override strings to a config dict IN PLACE (and return
+    it) — the exact semantics load_config gives CLI overrides, exposed so
+    other override producers (the supervisor's ladder rungs, preflight's
+    `--emit-ladder` output, tests pinning the round-trip) share one
+    parser."""
     for ov in overrides or []:
         ov = ov.lstrip("-")  # accept --key=val torchrun-style (reference :464-471)
         if "=" not in ov:
             raise ValueError(f"override {ov!r} is not of the form key=value")
         key, _, val = ov.partition("=")
         _set_path(cfg, key.strip(), _parse_scalar(val.strip()))
+    return cfg
+
+
+def load_config(path: str, overrides: list[str] | None = None) -> dict:
+    """Load YAML, apply `a.b=c` overrides, resolve `${}` interpolations."""
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    if not isinstance(cfg, dict):
+        raise ValueError(f"top-level config must be a mapping, got {type(cfg)}")
+    apply_overrides(cfg, overrides)
     return _resolve(cfg, cfg)
 
 

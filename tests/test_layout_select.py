@@ -1,0 +1,429 @@
+"""Cost-model auto-layout (tools/preflight.py layout lane), unit-tested as
+pure arithmetic — no compile, no subprocess except the supervisor walk: the
+fast lane the CI Layout gate runs.
+
+Pins: the (pp, tp, dp, sp) enumeration respects every trainer divisibility
+rule and preserves the global batch; the 65B/32-device frontier reproduces
+the hand-written conf/llama_65b_pp8_* family's layout (and refuses the
+pp8xdp4 layout the PR 8 compile measured at ~134 GiB/device); unequal
+partitions are scored with per-stage unit costs; `--emit-ladder` output
+walks tools/supervisor.py UNMODIFIED on an injected device loss; and every
+override string the lane can emit round-trips train.py's config validation
+(the tp>1 ce-axis suppression bug class, as a grid)."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import preflight  # tools/ on sys.path via conftest
+
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+from llama_pipeline_parallel_tpu.parallel import schedule as usched
+from llama_pipeline_parallel_tpu.utils.config import apply_overrides
+
+_REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+
+CFG65 = LlamaConfig.llama_65b()
+AW65 = (8, 2, 2, 1)  # the hand-written family's mesh
+# base 70 GiB: the PR 8 compiled peak minus its ring/stash terms (the
+# anchor --select derives from the one compile; here assumed, like
+# test_preflight_select.py assumes its base_gib)
+KW65 = dict(mb_rows=8, seq=512, global_batch_examples=4096,
+            base_gib_aw=70.0, aw_layout=AW65, hbm_gb=95.0,
+            chip_flops=197e12, solver_lane=False)
+
+TINY = LlamaConfig.tiny()
+
+
+def frontier65(devices=32, **over):
+    kw = {**KW65, **over}
+    return preflight.layout_frontier(CFG65, devices, **kw)
+
+
+@pytest.fixture(scope="module")
+def frontier65_rows():
+    """The 32-device 65B frontier, computed once for the acceptance
+    pins."""
+    return frontier65()
+
+
+@pytest.fixture(scope="module")
+def ladder65():
+    """The generated canonical-rung 65B ladder, built once."""
+    rungs, _ = preflight.build_ladder(
+        CFG65, 32, 8, 512, 4096, 70.0, AW65, 95.0, top_k=3,
+        schedule_file_for=None, chip_flops=197e12)
+    return rungs
+
+
+# ---------------------------------------------------------------------------
+# layout enumeration
+# ---------------------------------------------------------------------------
+
+def test_enumerate_layouts_respects_trainer_divisibility():
+    lays = preflight.enumerate_layouts(32, CFG65, seq=512,
+                                       global_batch_examples=4096, mb_rows=8)
+    assert lays
+    for lay in lays:
+        pp, tp, dp, sp = lay["pp"], lay["tp"], lay["dp"], lay["sp"]
+        assert pp * tp * dp * sp == 32
+        assert CFG65.num_attention_heads % tp == 0
+        assert CFG65.kv_heads % tp == 0
+        assert CFG65.intermediate_size % tp == 0
+        assert CFG65.vocab_size % tp == 0
+        assert 512 % sp == 0
+        # the elastic data contract: examples/step preserved exactly
+        assert 8 * lay["microbatches"] * dp == 4096
+        if lay["layer_counts"] is not None:
+            assert sum(lay["layer_counts"]) == CFG65.num_hidden_layers
+            assert len(lay["layer_counts"]) == pp
+    # layer-indivisible pp carries its cost-balanced partition (pp=32 on 80
+    # layers); divisible pp stays even
+    by_pp = {lay["pp"]: lay for lay in lays}
+    assert by_pp[32]["layer_counts"] is not None
+    assert by_pp[8]["layer_counts"] is None
+
+
+def test_enumerate_layouts_pp_capped_at_num_layers():
+    lays = preflight.enumerate_layouts(8, TINY, seq=32,
+                                       global_batch_examples=8, mb_rows=1)
+    assert lays and all(lay["pp"] <= TINY.num_hidden_layers for lay in lays)
+
+
+# ---------------------------------------------------------------------------
+# the 65B acceptance case
+# ---------------------------------------------------------------------------
+
+def test_65b_32dev_winner_reproduces_handwritten_layout(frontier65_rows):
+    """The acceptance criterion: the full-axes search at the 65B shape with
+    32 devices lands on the hand-written conf/llama_65b_pp8_* family's
+    pp8 x tp2 x dp2 mesh, running the zb1 v2 schedule at the 0.90% bubble
+    (the PR 7 pin), with the microbatch count of the configs of record."""
+    winner, rows = frontier65_rows
+    assert winner is not None
+    assert winner["layout"] == "pp8xtp2xdp2xsp1"
+    assert winner["microbatches"] == 256
+    assert winner["sched"]["schedule"] == "zb1"
+    assert winner["sched"]["virtual_stages"] == 2
+    assert winner["bubble_fraction"] == round(14 / 1550, 4)
+    # rows come back best-first and every infeasible row names why
+    scores = [r["score_s"] for r in rows if r["feasible"]]
+    assert scores == sorted(scores)
+    assert all(r["why_not"] for r in rows if not r["feasible"])
+
+
+def test_65b_memory_model_refuses_the_tp1_dp4_layout(frontier65_rows):
+    """pp8 x tp1 x dp4 is the layout PR 8's compile measured at ~134
+    GiB/device (the 65B config header's story for why tp=2 is
+    load-bearing) — the analytic model must refuse it, not rank it."""
+    _, rows = frontier65_rows
+    r = next(r for r in rows if r["layout"] == "pp8xtp1xdp4xsp1")
+    assert not r["feasible"]
+    assert r["base_gib"] > 95.0
+
+
+def test_65b_uneven_pp32_scored_with_stage_costs(frontier65_rows):
+    """pp=32 on 80 layers only exists as a (3,3,...,2,...) balanced
+    partition; its bubble must count the per-tick imbalance (the max-cost
+    wall vs lighter stages' useful work), not just fill/drain idle."""
+    _, rows = frontier65_rows
+    r = next(r for r in rows if r["pp"] == 32)
+    assert r["layer_counts"] is not None and max(r["layer_counts"]) == 3
+    if r["feasible"]:
+        even_zb1 = next(x for x in rows if x["layout"] == "pp8xtp2xdp2xsp1")
+        assert r["bubble_fraction"] > 0.15 > even_zb1["bubble_fraction"]
+
+
+def test_score_charges_tp_and_sp_collectives():
+    """At a fixed bubble, the analytic score must grow with tp (4 Megatron
+    allreduces per layer per microbatch) and with sp (ring-attention
+    rotations) — the terms that keep collective-heavy layouts from winning
+    on bubble alone."""
+    def score(tp, dp, sp):
+        # G preserved: M compensates dp, exactly as enumerate_layouts does
+        lay = {"pp": 8, "tp": tp, "dp": dp, "sp": sp,
+               "microbatches": 4096 // (8 * dp), "layer_counts": None}
+        return preflight.layout_step_seconds(CFG65, lay, 0.01, 8, 512,
+                                             0.45, 197e12, 90.0)
+
+    t1, t2, t4 = score(1, 4, 1), score(2, 2, 1), score(4, 1, 1)
+    assert t1 < t2 < t4
+    assert score(1, 2, 2) > t1
+
+
+def test_ce_axis_suppressed_at_tp_layouts(frontier65_rows):
+    """The tp>1 ce-axis suppression bug class, at the LAYOUT level: a tp>1
+    layout's chosen schedule must never carry loss_chunks/kernels.ce
+    overrides (the trainer rejects them — the vocab-parallel head owns
+    that regime), while tp=1 layouts may."""
+    _, rows = frontier65_rows
+    for r in rows:
+        if not r["feasible"]:
+            continue
+        line = " ".join(preflight.layout_overrides(r))
+        if r["tp"] > 1:
+            assert "kernels.ce" not in line
+            assert "loss_vocab_chunks" not in line
+
+
+# ---------------------------------------------------------------------------
+# the generated ladder
+# ---------------------------------------------------------------------------
+
+def test_ladder_preserves_global_batch_and_halves_devices(ladder65):
+    rungs = ladder65
+    assert rungs and rungs[0]["name"].startswith("pp8xtp2xdp2xsp1")
+    assert len([r for r in rungs if r["devices"] == 32]) <= 3
+    devs = [r["devices"] for r in rungs]
+    assert devs == sorted(devs, reverse=True)  # best-first
+    for rung in rungs:
+        ov = {k: v for k, v in
+              (o.split("=", 1) for o in rung["overrides"])}
+        mesh_prod = (int(ov["mesh.pp"]) * int(ov["mesh.tp"])
+                     * int(ov["mesh.dp"]) * int(ov["mesh.sp"]))
+        assert mesh_prod == rung["devices"]
+        assert 8 * int(ov["gradient_accumulation_steps"]) \
+            * int(ov["mesh.dp"]) == 4096
+        # canonical-only rungs without a sequence file source
+        assert ov["pipeline_schedule"] != "solver"
+
+
+def test_ladder_solver_rungs_carry_schedule_files(tmp_path):
+    wrote = {}
+
+    def sfile(name, pcfg):
+        path = str(tmp_path / f"{name}.schedule.json")
+        with open(path, "w") as fh:
+            fh.write(usched.to_json(pcfg.unit_schedule))
+        wrote[name] = path
+        return path
+
+    rungs, _ = preflight.build_ladder(
+        TINY, 4, 1, 32, 8, 1.0, (2, 1, 2, 1), 95.0, top_k=2,
+        schedule_file_for=sfile, chip_flops=1e12)
+    assert rungs
+    for rung in rungs:
+        ov = dict(o.split("=", 1) for o in rung["overrides"])
+        if ov["pipeline_schedule"] == "solver":
+            path = ov["schedule_file"]
+            assert os.path.isfile(path)
+            seq = usched.load(path)  # validates on load
+            assert seq.num_stages == int(ov["mesh.pp"])
+
+
+def _sup():
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import supervisor
+
+    return supervisor
+
+
+_CHILD = r"""
+import json, os, sys
+argv_log, marker = sys.argv[1], sys.argv[2]
+with open(argv_log, "a") as f:
+    f.write(json.dumps(sys.argv[3:]) + "\n")
+if not os.path.exists(marker):
+    open(marker, "w").close()
+    sys.exit(1)   # first incarnation crashes
+sys.exit(0)
+"""
+
+
+def test_generated_ladder_walks_supervisor_on_device_loss(tmp_path,
+                                                          monkeypatch):
+    """The acceptance criterion's second half: `--emit-ladder` output walks
+    tools/supervisor.py UNMODIFIED — first launch runs the top rung, a
+    crash + injected device loss drops to the first rung that fits the
+    surviving chips, and the resize lands in the incarnation ledger."""
+    from llama_pipeline_parallel_tpu.utils import faults
+
+    supervisor = _sup()
+    rungs, _ = preflight.build_ladder(
+        TINY, 4, 1, 32, 8, 1.0, (2, 1, 2, 1), 95.0, top_k=1,
+        schedule_file_for=None, chip_flops=1e12)
+    assert {r["devices"] for r in rungs} >= {4, 2}
+    ladder_path = tmp_path / "ladder.json"
+    ladder_path.write_text(json.dumps(rungs))
+
+    out = str(tmp_path / "run")
+    argv_log = str(tmp_path / "argv.jsonl")
+    marker = str(tmp_path / "crashed.marker")
+    monkeypatch.setenv("LPT_DEVICE_COUNT", "4")
+    faults.configure({"faults": [
+        {"site": "device_probe", "op": "device_loss", "devices": 2,
+         "after": 1}]})
+    try:
+        sup = supervisor.Supervisor(
+            [sys.executable, "-c", _CHILD, argv_log, marker],
+            supervisor.SupervisorConfig(output_dir=out, max_restarts=2,
+                                        hang_timeout_s=60, poll_s=0.05,
+                                        ladder=supervisor.parse_ladder(
+                                            f"@{ladder_path}")))
+        assert sup.run() == 0
+    finally:
+        faults.configure(None)
+    argvs = [json.loads(l) for l in open(argv_log)]
+    assert argvs[0] == rungs[0]["overrides"]
+    second = next(r for r in rungs if r["devices"] <= 2)
+    assert argvs[1] == second["overrides"]
+    ledger = [json.loads(l)
+              for l in open(os.path.join(out, "incarnations.jsonl"))]
+    assert [r["outcome"] for r in ledger] == ["crash", "clean"]
+    assert ledger[1]["resized"] is True
+    assert ledger[0]["layout"] == rungs[0]["name"]
+
+
+# ---------------------------------------------------------------------------
+# override round-trip: nothing the lane emits may be rejected by train.py
+# ---------------------------------------------------------------------------
+
+def _validate_through_trainer(overrides, model_node, devices):
+    """Apply an emitted override list to a minimal config and run it
+    through the trainer's OWN builders — the round-trip that catches the
+    tp>1-ce-suppression bug class before a launch line does."""
+    from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig
+    from llama_pipeline_parallel_tpu.train import (
+        build_manifest,
+        build_model_config,
+        build_pipeline_config,
+    )
+
+    cfg = {"model": dict(model_node), "mesh": {},
+           "per_device_train_batch_size": 1}
+    apply_overrides(cfg, list(overrides))
+    mesh_cfg = MeshConfig(**cfg.get("mesh", {}))
+    assert mesh_cfg.world_size == devices
+    model_cfg = build_model_config(cfg["model"])
+    manifest = build_manifest(cfg, model_cfg, mesh_cfg.pp)
+    pcfg = build_pipeline_config(cfg, mesh_cfg, manifest)
+    return pcfg
+
+
+def test_every_emitted_override_roundtrips_train_validation(tmp_path):
+    """The grid: every frontier row's override line at two device counts on
+    the tiny model — uneven partitions, sp/tp meshes, offload knobs, the
+    ce axis, and solver rungs with their sequence files — must construct a
+    valid PipelineConfig through train.py's builders (no winner the
+    trainer then rejects)."""
+    model_node = {"preset": "tiny"}
+
+    def sfile(name, pcfg):
+        path = str(tmp_path / f"{name}.schedule.json")
+        with open(path, "w") as fh:
+            fh.write(usched.to_json(pcfg.unit_schedule))
+        return path
+
+    checked = 0
+    for devices in (4, 8):
+        _, rows = preflight.layout_frontier(
+            TINY, devices, mb_rows=1, seq=32, global_batch_examples=16,
+            base_gib_aw=1.0, aw_layout=(2, 1, 2, 1), hbm_gb=95.0,
+            chip_flops=1e12, solver_lane=True)
+        for r in rows:
+            if not r["feasible"]:
+                continue
+            sched_file = None
+            if r["sched"]["schedule"] == "solver":
+                sched_file = sfile(r["layout"], r["sched"]["_pcfg"])
+            overrides = preflight.layout_overrides(
+                r, schedule_file=sched_file)
+            pcfg = _validate_through_trainer(overrides, model_node, devices)
+            assert pcfg.num_stages == r["pp"]
+            assert pcfg.num_microbatches == r["microbatches"]
+            checked += 1
+    assert checked >= 8  # the grid actually covered a spread of layouts
+
+
+def test_emitted_ladder_rungs_roundtrip_train_validation(ladder65):
+    """Same contract for the 65B ladder's rungs (preset model node, real
+    mesh overrides) — each rung is exactly what the supervisor appends to
+    the launch line."""
+    for rung in ladder65:
+        pcfg = _validate_through_trainer(
+            rung["overrides"] + ["per_device_train_batch_size=8"],
+            {"preset": "llama_65b", "dtype": "bfloat16"}, rung["devices"])
+        assert pcfg is not None
+
+
+# ---------------------------------------------------------------------------
+# topology metadata: partition changes are named, not silent
+# ---------------------------------------------------------------------------
+
+def test_topology_meta_records_layer_counts(devices):
+    from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+    from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
+    from llama_pipeline_parallel_tpu.train import _topology_meta
+
+    mesh = make_mesh(MeshConfig(pp=4))
+    pcfg = pl.PipelineConfig(num_stages=4, num_microbatches=4,
+                             layer_counts=(4, 4, 4, 1))
+    man = StageManifest(num_layers=13, num_stages=4,
+                        layer_counts=(4, 4, 4, 1))
+    topo = _topology_meta(mesh, pcfg, man)
+    assert topo["layer_counts"] == [4, 4, 4, 1]
+    even = _topology_meta(mesh, pl.PipelineConfig(num_stages=4,
+                                                  num_microbatches=4),
+                          StageManifest(num_layers=8, num_stages=4))
+    assert even["layer_counts"] == "even/2"
+
+
+def test_note_topology_change_names_partition_change(devices, caplog):
+    """A (4,4,4,1) -> even/2 restore is logged as an elastic topology
+    change naming `layer_counts`, like a pp/dp/tp change — never a silent
+    reshard; a pre-partition-aware source (no layer_counts key) must not
+    flag a phantom change."""
+    import logging
+
+    import llama_pipeline_parallel_tpu.train as train_mod
+    from llama_pipeline_parallel_tpu.train import _note_topology_change
+
+    class FakeMgr:
+        def __init__(self, topo):
+            self._topo = topo
+
+        def load_meta(self, step):
+            return {"topology": self._topo}
+
+    current = {"pp": 2, "dp": 1, "tp": 1, "sp": 1, "schedule": "1f1b",
+               "virtual_stages": 1, "layout": "pp2xdp1xtp1xsp1",
+               "layer_counts": "even/2"}
+    src = {**current, "pp": 4, "layout": "pp4xdp1xtp1xsp1",
+           "layer_counts": [4, 4, 4, 1]}
+    # the package logger does not propagate to root: capture directly
+    train_mod.logger.addHandler(caplog.handler)
+    try:
+        with caplog.at_level(logging.INFO):
+            _note_topology_change(FakeMgr(src), 7, current)
+            assert any("'layer_counts'" in rec.getMessage()
+                       for rec in caplog.records)
+            caplog.clear()
+            legacy = {k: v for k, v in current.items()
+                      if k != "layer_counts"}
+            _note_topology_change(FakeMgr(legacy), 7, current)
+            assert not any("elastic restore" in rec.getMessage()
+                           for rec in caplog.records)
+    finally:
+        train_mod.logger.removeHandler(caplog.handler)
+
+
+def test_dp_gradient_reduction_cost_respects_zero2():
+    """Without ZeRO-2's reduce-scatter the dp term is a full allreduce
+    (2(dp-1)/dp) — twice the bytes; the score must charge it, or high-dp
+    layouts get under-costed on non-zero2 configs."""
+    lay = {"pp": 8, "tp": 1, "dp": 4, "sp": 1, "microbatches": 128,
+           "layer_counts": None}
+    rs = preflight.layout_step_seconds(CFG65, lay, 0.01, 8, 512, 0.45,
+                                       197e12, 90.0, zero2=True)
+    ar = preflight.layout_step_seconds(CFG65, lay, 0.01, 8, 512, 0.45,
+                                       197e12, 90.0, zero2=False)
+    assert ar > rs
+    nodp = {**lay, "dp": 1, "tp": 4, "microbatches": 512}
+    assert preflight.layout_step_seconds(
+        CFG65, nodp, 0.01, 8, 512, 0.45, 197e12, 90.0, zero2=False) == \
+        preflight.layout_step_seconds(
+        CFG65, nodp, 0.01, 8, 512, 0.45, 197e12, 90.0, zero2=True)

@@ -26,17 +26,23 @@ from tests.test_pipeline import (
 
 
 def run_uneven(params, batch, cfg, pp, counts, microbatches=4, schedule="1f1b",
-               dp=1, tp=1):
+               dp=1, tp=1, unit_schedule=None):
     mesh = make_mesh(MeshConfig(pp=pp, dp=dp, tp=tp))
     manifest = StageManifest(num_layers=cfg.num_hidden_layers, num_stages=pp,
                              layer_counts=tuple(counts))
     stacked = pl.stack_stages(params, manifest)
     pcfg = pl.PipelineConfig(num_stages=pp, num_microbatches=microbatches,
                              schedule=schedule,
-                             layer_counts=manifest.stage_layer_counts)
+                             layer_counts=manifest.stage_layer_counts,
+                             unit_schedule=unit_schedule)
     fn = jax.jit(pl.make_pipeline_loss_and_grad(mesh, cfg, pcfg, stacked))
     loss, grads = fn(stacked, batch)
     return loss, pl.unstack_stages(grads, manifest), manifest
+
+
+def assert_trees_bit_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
 @pytest.mark.slow
@@ -49,6 +55,122 @@ def test_13_layers_on_4_stages_matches_single_device(devices):
     loss, grads, _ = run_uneven(params, batch, cfg, pp=4, counts=(4, 4, 4, 1))
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
     assert_tree_close(grads, ref_grads)
+
+
+# ---------------------------------------------------------------------------
+# Unequal stages through the unit-sequence interpreter (zb1 / solver):
+# "unequal stages just change the unit sequence" — the split backward and a
+# loaded sequence replay the SAME padded chunk function, so losses AND
+# grads are bit-exact vs the flat uneven path (which already matches the
+# single-device reference above). Grid extended, not forked.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def uneven_flat_ref(devices):
+    """One flat-1f1b uneven run at the shared (pp2, (2,1), m=2) shape —
+    the parity anchor both interpreter reps compare against (one compile,
+    not one per test: tier-1 budget)."""
+    cfg = LlamaConfig.tiny(num_hidden_layers=3)
+    params = llama.init_params(jax.random.PRNGKey(5), cfg)
+    batch = make_batch(cfg, batch_size=4)
+    l_flat, g_flat, _ = run_uneven(params, batch, cfg, pp=2, counts=(2, 1),
+                                   microbatches=2)
+    return cfg, params, batch, l_flat, g_flat
+
+
+def test_zb1_uneven_bit_exact_vs_flat_uneven(uneven_flat_ref):
+    """The fast rep of the unequal-stage interpreter gate: zb1's B/W split
+    on a (2,1) partition folds the identical gradients in the identical
+    order as flat 1f1b — bit-exact, not allclose."""
+    cfg, params, batch, l_flat, g_flat = uneven_flat_ref
+    l_zb, g_zb, _ = run_uneven(params, batch, cfg, pp=2, counts=(2, 1),
+                               microbatches=2, schedule="zb1")
+    np.testing.assert_array_equal(np.asarray(l_flat), np.asarray(l_zb))
+    assert_trees_bit_equal(g_flat, g_zb)
+
+
+@pytest.mark.slow
+def test_solver_uneven_sequence_roundtrips_and_replays_bit_exact(
+        uneven_flat_ref, tmp_path):
+    """A canonical zb1 sequence generated WITH stage costs, serialized to
+    the JSON file a ladder rung would reference, loaded through the
+    trainer's own loader, and replayed by the interpreter on the uneven
+    partition — bit-exact vs flat uneven. Slow-marked (tier-1 budget):
+    the fast interpreter rep is the zb1 test above, and the stage-costs
+    JSON/validation wrinkles are covered fast in test_unit_schedule.py;
+    the 13-on-4 acceptance pair replays the solver leg in the round
+    gate."""
+    from llama_pipeline_parallel_tpu.parallel import schedule as usched
+
+    cfg, params, batch, l_flat, g_flat = uneven_flat_ref
+    seq = usched.canonical_schedule("zb1", 2, 2, stage_costs=(2, 1))
+    path = tmp_path / "uneven.schedule.json"
+    path.write_text(usched.to_json(seq))
+    loaded = usched.load(str(path))
+    assert loaded.stage_costs == (2, 1)
+    l_sv, g_sv, _ = run_uneven(params, batch, cfg, pp=2, counts=(2, 1),
+                               microbatches=2, schedule="solver",
+                               unit_schedule=loaded)
+    np.testing.assert_array_equal(np.asarray(l_flat), np.asarray(l_sv))
+    assert_trees_bit_equal(g_flat, g_sv)
+
+
+@pytest.mark.parametrize("schedule", ["zb1", "solver"])
+@pytest.mark.slow
+def test_13_layers_on_4_stages_zb1_solver_acceptance(devices, schedule):
+    """The acceptance criterion: an unequal-stage zb1/solver sequence (13
+    layers on 4 stages) replays losses AND grads bit-exact vs the flat
+    uneven path and matches the single-device reference."""
+    from llama_pipeline_parallel_tpu.parallel import schedule as usched
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=13)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    batch = make_batch(cfg)
+    counts = (4, 4, 4, 1)
+    unit_schedule = None
+    if schedule == "solver":
+        unit_schedule = usched.from_json(usched.to_json(
+            usched.canonical_schedule("zb1", 4, 4, stage_costs=counts)))
+    ref_loss, ref_grads = reference_loss_and_grad(params, batch, cfg)
+    l_flat, g_flat, _ = run_uneven(params, batch, cfg, pp=4, counts=counts)
+    loss, grads, _ = run_uneven(params, batch, cfg, pp=4, counts=counts,
+                                schedule=schedule,
+                                unit_schedule=unit_schedule)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    assert_tree_close(grads, ref_grads)
+    np.testing.assert_array_equal(np.asarray(l_flat), np.asarray(loss))
+    assert_trees_bit_equal(g_flat, grads)
+
+
+def test_uneven_rejected_where_no_uneven_form_exists():
+    """interleaved_1f1b (and any v>1) keeps the even-partition rejection:
+    the round-robin chunk layout has no uneven form."""
+    with pytest.raises(ValueError, match="no uneven form"):
+        pl.PipelineConfig(num_stages=2, num_microbatches=4,
+                          schedule="interleaved_1f1b",
+                          layer_counts=(2, 1))
+    with pytest.raises(ValueError, match="no uneven form"):
+        pl.PipelineConfig(num_stages=2, num_microbatches=4,
+                          schedule="zb1", virtual_stages=2,
+                          layer_counts=(2, 1))
+    # zb1 at v=1 is the lifted case
+    pl.PipelineConfig(num_stages=2, num_microbatches=4, schedule="zb1",
+                      layer_counts=(2, 1))
+
+
+def test_solver_sequence_partition_mismatch_rejected():
+    """A sequence generated for one partition cannot silently run another:
+    the config validation names the mismatch."""
+    from llama_pipeline_parallel_tpu.parallel import schedule as usched
+
+    seq = usched.canonical_schedule("zb1", 4, 2, stage_costs=(2, 1))
+    with pytest.raises(ValueError, match="stage layer counts"):
+        pl.PipelineConfig(num_stages=2, num_microbatches=4,
+                          schedule="solver", unit_schedule=seq,
+                          layer_counts=(1, 2))
+    with pytest.raises(ValueError, match="stage layer counts"):
+        pl.PipelineConfig(num_stages=2, num_microbatches=4,
+                          schedule="solver", unit_schedule=seq)
 
 
 @pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
@@ -95,11 +217,18 @@ def test_padded_slot_grads_are_zero(devices):
         np.testing.assert_array_equal(np.asarray(leaf)[1, 1], 0.0)
 
 
+@pytest.mark.parametrize("target", [
+    StageManifest(num_layers=6, num_stages=2),
+    StageManifest(num_layers=6, num_stages=3),
+    StageManifest(num_layers=6, num_stages=4, layer_counts=(2, 2, 1, 1)),
+    StageManifest(num_layers=6, num_stages=3, layer_counts=(3, 2, 1)),
+], ids=["even2", "even3", "same-uneven", "other-uneven"])
 @pytest.mark.slow
-def test_ckpt_restore_across_partition_change(devices, tmp_path):
-    """Save under an uneven PP=4 partition, restore into an even PP=2 one:
-    the canonical checkpoint layout is partition-agnostic (the reference's
-    filename arithmetic forbids exactly this, SURVEY.md §7.3 item 5)."""
+def test_ckpt_restore_across_partition_change(devices, tmp_path, target):
+    """Save under an uneven PP=4 partition, restore into even AND uneven
+    targets: the canonical checkpoint layout is partition-agnostic (the
+    reference's filename arithmetic forbids exactly this, SURVEY.md §7.3
+    item 5) — the grid a generated-ladder resize walks."""
     from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
 
     cfg = LlamaConfig.tiny(num_hidden_layers=6)
@@ -110,12 +239,12 @@ def test_ckpt_restore_across_partition_change(devices, tmp_path):
     mgr = CheckpointManager(str(tmp_path))
     mgr.save(7, stacked_uneven, uneven, cfg)
 
-    even = StageManifest(num_layers=6, num_stages=2)
     template = pl.stack_stages(
         jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params),
-        even)
-    restored = mgr.load_params(7, template, even)
-    assert_tree_close(pl.unstack_stages(restored, even), params, rtol=0, atol=0)
+        target)
+    restored = mgr.load_params(7, template, target)
+    assert_tree_close(pl.unstack_stages(restored, target), params,
+                      rtol=0, atol=0)
 
 
 def test_balanced_factory_properties():

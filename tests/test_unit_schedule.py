@@ -417,3 +417,86 @@ def test_validator_rejects_degenerate_slot_metadata():
     wq[3] = -1
     with pytest.raises(us.ScheduleError, match="negative wq_slot"):
         us.validate(dataclasses.replace(zb, wq_slot=wq))
+
+
+# ---------------------------------------------------------------------------
+# Per-stage unit costs: unequal partitions in the bubble accounting
+# ---------------------------------------------------------------------------
+
+def test_stage_costs_bubble_weighting_by_hand():
+    """The costed accounting at a shape small enough to count by hand:
+    flat fused 1f1b, m=4, S=2, costs (2,1). Every one of the 6 ticks is
+    structurally F+B, wall per stage = (6*1 + 6*2) * cmax(2) = 36, total
+    72; useful = F (4 units * cost per stage: 4*2 + 4*1 = 12) + B (twice
+    that, fused cost 2) = 36 -> bubble 1/2, vs the even 1/3."""
+    seq = us.generate_1f1b(4, 2, stage_costs=(2, 1))
+    idle, wall = us.bubble_stats(seq)
+    assert (idle, wall) == (36, 72)
+    assert us.analytic_bubble(seq) == 0.5
+    assert us.analytic_bubble(us.generate_1f1b(4, 2)) == pytest.approx(1 / 3)
+
+
+def test_uniform_stage_costs_bit_identical_to_uncosted():
+    """A uniform cost vector (an even partition's k) must reduce to the
+    identical rational — floats bit-equal, the canonical-parity
+    contract."""
+    for sched, v in (("1f1b", 1), ("interleaved_1f1b", 2), ("zb1", 2)):
+        a = us.analytic_bubble(us.canonical_schedule(sched, 8, 4, v))
+        b = us.analytic_bubble(us.canonical_schedule(sched, 8, 4, v,
+                                                     stage_costs=(10,) * 4))
+        assert a == b  # bit-equal, not approx
+
+
+def test_stage_costs_json_roundtrip_and_validation():
+    seq = us.canonical_schedule("zb1", 4, 4, stage_costs=(4, 4, 4, 1))
+    seq2 = us.from_json(us.to_json(seq))
+    assert seq2.stage_costs == (4, 4, 4, 1)
+    assert us.bubble_stats(seq2) == us.bubble_stats(seq)
+    # costless documents still round-trip (no stage_costs key)
+    plain = us.from_json(us.to_json(us.canonical_schedule("zb1", 4, 4)))
+    assert plain.stage_costs is None
+    with pytest.raises(us.ScheduleError, match="entries for"):
+        us.generate_1f1b(4, 2, stage_costs=(2, 1, 1))
+    with pytest.raises(us.ScheduleError, match=">= 1"):
+        us.generate_1f1b(4, 2, stage_costs=(2, 0))
+    with pytest.raises(us.ScheduleError, match="no uneven form"):
+        us.generate_interleaved(4, 2, 2, stage_costs=(2, 1))
+    bad = dataclasses.replace(us.canonical_schedule("1f1b", 4, 2),
+                              stage_costs=(1, 2, 3))
+    with pytest.raises(us.ScheduleError, match="entries for"):
+        us.validate(bad)
+
+
+def test_pipeline_bubble_fraction_counts_uneven_costs():
+    """pipeline.bubble_fraction threads layer_counts into the sequence's
+    cost accounting: the uneven zb1 bubble is the costed sequence's
+    number, strictly above its even twin at the same shape."""
+    uneven = pl.PipelineConfig(num_stages=4, num_microbatches=8,
+                               schedule="zb1", layer_counts=(4, 4, 4, 1))
+    even = pl.PipelineConfig(num_stages=4, num_microbatches=8,
+                             schedule="zb1")
+    seq = us.canonical_schedule("zb1", 8, 4, stage_costs=(4, 4, 4, 1))
+    assert pl.bubble_fraction(uneven) == us.analytic_bubble(seq)
+    assert pl.bubble_fraction(uneven) > pl.bubble_fraction(even)
+    assert "layers/stage=[4, 4, 4, 1]" in us.ascii_timeline(seq)
+
+
+def test_uniform_cost_sequence_on_uneven_run_gets_run_costs():
+    """A sequence carrying UNIFORM stage costs is the same accounting as a
+    costless one: run on an unequal partition, the run's real layer counts
+    are attached (never the uniform vector's k), so the reported bubble is
+    the honest costed number — the uniform-costs bypass of the
+    partition-mismatch check cannot pin wrong accounting."""
+    uniform = us.canonical_schedule("zb1", 4, 2, stage_costs=(2, 2))
+    pcfg = pl.PipelineConfig(num_stages=2, num_microbatches=4,
+                             schedule="solver", unit_schedule=uniform,
+                             layer_counts=(3, 1))
+    costed = us.canonical_schedule("zb1", 4, 2, stage_costs=(3, 1))
+    assert pl.bubble_fraction(pcfg) == us.analytic_bubble(costed)
+    # genuinely uneven sequence costs still refuse a mismatched run
+    with pytest.raises(ValueError, match="stage layer counts"):
+        pl.PipelineConfig(num_stages=2, num_microbatches=4,
+                          schedule="solver",
+                          unit_schedule=us.canonical_schedule(
+                              "zb1", 4, 2, stage_costs=(3, 1)),
+                          layer_counts=(1, 3))

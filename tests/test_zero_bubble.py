@@ -416,10 +416,18 @@ def test_zb1_requires_divisible_microbatches():
                           schedule="zb1", virtual_stages=2)
 
 
-def test_zb1_rejects_uneven_partition():
-    with pytest.raises(ValueError, match="even"):
+def test_zb1_uneven_partition_needs_v1():
+    """Since the auto-layout PR, zb1 at v=1 RUNS unequal partitions
+    through the unit interpreter (tests/test_uneven_stages.py has the
+    parity grid); the round-robin chunk layout (any v>1) still has no
+    uneven form and keeps the rejection."""
+    pcfg = pl.PipelineConfig(num_stages=2, num_microbatches=4,
+                             schedule="zb1", layer_counts=(5, 3))
+    assert pcfg.layer_counts == (5, 3)
+    with pytest.raises(ValueError, match="no uneven form"):
         pl.PipelineConfig(num_stages=2, num_microbatches=4,
-                          schedule="zb1", layer_counts=(5, 3))
+                          schedule="zb1", virtual_stages=2,
+                          layer_counts=(5, 3))
 
 
 def test_zb1_layout_schedule_mismatch_fails_at_build(cfg, params, devices):

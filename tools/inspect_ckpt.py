@@ -57,9 +57,15 @@ def describe(root: str, step: int | None = None) -> dict:
     if inspect_step is not None and inspect_step in steps:
         meta = mgr.load_meta(inspect_step)
         man = meta.get("manifest", {})
+        # the partition label: the manifest's explicit counts, else the
+        # topology block's layer_counts (recorded since the generated-ladder
+        # era so partition-changing resizes are named, not silent), else the
+        # even split derived from the manifest
+        topo = meta.get("topology") or {}
         out["checkpoint"] = {
             "step": meta.get("step"),
             "stage_partition": (man.get("layer_counts")
+                                or topo.get("layer_counts")
                                 or f"even: {man.get('num_layers')} layers / "
                                    f"{man.get('num_stages')} stages"),
             "model_config": meta.get("model_config"),

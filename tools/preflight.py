@@ -144,6 +144,16 @@ def offload_feasibility(pcfg, dims: tuple, step_compute_s: float,
 # schedule/memory trade instead of hand-picking it — PAPERS.md 2510.05186)
 # ---------------------------------------------------------------------------
 
+def _stash_device_bytes(hbm_slots: int, host_slots: int, slot: int) -> int:
+    """Device-resident bytes of a W queue's slot split: the full HBM-side
+    buffers plus, when anything tiers to host, the in-flight transfer
+    slots (2 per buffer direction, capped at 4 slot-equivalents). ONE
+    spelling shared by candidate_device_terms_gib and solver_candidates'
+    binary-search estimator so the two can never drift."""
+    return 2 * hbm_slots * slot + (min(2 * host_slots * slot, 4 * slot)
+                                   if host_slots else 0)
+
+
 def candidate_device_terms_gib(pcfg, dims: tuple, vocab: int | None = None
                                ) -> dict:
     """The schedule-DEPENDENT device-memory terms of one candidate, GiB:
@@ -163,8 +173,7 @@ def candidate_device_terms_gib(pcfg, dims: tuple, vocab: int | None = None
     ring = pl.activation_ring_bytes(pcfg, *dims)
     ring_dev = min(ring, 2 * slot) if pcfg.offload_activations else ring
     hbm_slots, host_slots = pl.wgrad_partition(pcfg)
-    stash_dev = 2 * hbm_slots * slot + (
-        min(2 * host_slots * slot, 4 * slot) if host_slots else 0)
+    stash_dev = _stash_device_bytes(hbm_slots, host_slots, slot)
     head = (pl.loss_head_bytes(pcfg, mb_rows, local_seqlen, hidden_size,
                                vocab) if vocab else 0)
     return {"ring_gib": ring_dev / gib, "stash_gib": stash_dev / gib,
@@ -175,7 +184,8 @@ def candidate_device_terms_gib(pcfg, dims: tuple, vocab: int | None = None
 def enumerate_candidates(num_stages: int, microbatches: int, num_layers: int,
                          max_virtual: int = 4,
                          accum_options: tuple = (1, 2, 4, 8),
-                         ce_options: tuple | None = None) -> list:
+                         ce_options: tuple | None = None,
+                         layer_counts: tuple | None = None) -> list:
     """Every valid PipelineConfig in the selection grid: schedule x
     virtual_stages (layer-divisible) x accum_chunks (microbatch-divisible)
     x offload tiers (wgrad for zb1, activations for all hand-written
@@ -183,15 +193,27 @@ def enumerate_candidates(num_stages: int, microbatches: int, num_layers: int,
     entry a (loss_chunks, kernel_ce) pair (docs/KERNELS.md; the default
     keeps the legacy grid so the axis is opt-in). Validity delegates to
     PipelineConfig's own constructor — one source of truth for the
-    divisibility rules."""
+    divisibility rules.
+
+    `layer_counts`: an UNEQUAL stage partition (from
+    StageManifest.balanced at layer-indivisible pp — the layout lane's
+    cost-balancing). Offered to the flat and zb1-v1 schedules only (the
+    round-robin chunk layout has no uneven form); their bubble_fraction is
+    then counted with per-stage unit costs (parallel/schedule.py)."""
     from llama_pipeline_parallel_tpu.parallel import pipeline as pl
 
+    uneven = (layer_counts is not None and len(set(layer_counts)) != 1)
     ce_axis = tuple(ce_options) if ce_options else ((1, False),)
     cands = []
     for schedule in ("1f1b", "interleaved_1f1b", "zb1"):
-        vs = ((1,) if schedule == "1f1b" else
-              tuple(v for v in (1, 2, 4)
-                    if v <= max_virtual and num_layers % (num_stages * v) == 0))
+        if schedule == "1f1b":
+            vs = (1,)
+        elif uneven:
+            vs = (1,) if schedule == "zb1" else ()
+        else:
+            vs = tuple(v for v in (1, 2, 4)
+                       if v <= max_virtual
+                       and num_layers % (num_stages * v) == 0)
         for v in vs:
             for c in accum_options:
                 offloads = [(False, False), (False, True)]
@@ -207,7 +229,8 @@ def enumerate_candidates(num_stages: int, microbatches: int, num_layers: int,
                                 accum_chunks=c, offload_wgrad=ow,
                                 offload_activations=oa,
                                 loss_chunks=ce_chunks,
-                                kernel_ce=ce_kernel))
+                                kernel_ce=ce_kernel,
+                                layer_counts=layer_counts))
                         except ValueError:
                             continue
     return cands
@@ -261,18 +284,31 @@ def solver_candidates(num_stages: int, microbatches: int, num_layers: int,
                         schedule="solver", virtual_stages=v, accum_chunks=c,
                         unit_schedule=s)
 
-                def est(pcfg):
-                    # must mirror select_schedule's scoring, including the
+                # the ring term is offload-vector-invariant: hoist it out
+                # of the binary search
+                ring = seq.ring_slots * slot if bool(seq.has_f.any()) else 0
+
+                def est(vector):
+                    # must mirror select_schedule's scoring — candidate_
+                    # device_terms_gib for a no-activation-offload solver
+                    # config (the stash term via the SHARED
+                    # _stash_device_bytes spelling) — including the
                     # loss-head term it charges when a vocab is in play
                     # (`head_gib` — solver rows run the as-written dense
                     # head; a vector sized without it would come up short
-                    # at exactly the tight budgets this lane exists for)
-                    t = candidate_device_terms_gib(pcfg, dims)
-                    return base_gib + t["ring_gib"] + t["stash_gib"] + head_gib
+                    # at exactly the tight budgets this lane exists for).
+                    # Computed from the slot assignment DIRECTLY (not via
+                    # a PipelineConfig, whose constructor re-validates the
+                    # whole sequence — the binary search probes this a
+                    # dozen times per grid point, and the layout lane runs
+                    # the grid per mesh)
+                    s = usched.with_offload(seq, vector)
+                    stash = _stash_device_bytes(s.wq_hbm_slots,
+                                                s.wq_host_slots, slot)
+                    return base_gib + (ring + stash) / gib + head_gib
 
                 n = seq.n_units
-                none_off = build(np.zeros(n, bool))
-                if est(none_off) <= hbm_gb:
+                if est(np.zeros(n, bool)) <= hbm_gb:
                     k = 0
                 else:
                     # minimal k: tier the earliest-scheduled units first
@@ -284,7 +320,7 @@ def solver_candidates(num_stages: int, microbatches: int, num_layers: int,
                         mid = (lo + hi) // 2
                         vec = np.zeros(n, bool)
                         vec[:mid] = True
-                        if est(build(vec)) <= hbm_gb:
+                        if est(vec) <= hbm_gb:
                             hi = mid
                         else:
                             lo = mid + 1
@@ -371,6 +407,309 @@ def ce_axis_options(loss_chunks: int, vocab: int, tp: int) -> tuple | None:
     if vocab % 128 == 0:
         opts.add((vocab // 128, True))
     return tuple(sorted(opts))
+
+
+# ---------------------------------------------------------------------------
+# Layout auto-selection: grow the OUTER (pp, tp, dp, sp) axes for a device
+# count, re-evaluate the memory model per candidate mesh, rank the frontier
+# by an analytic step-time score, and emit the supervisor ladder as DATA
+# (ROADMAP item 3: the hand-written --layout-ladder becomes generated).
+# ---------------------------------------------------------------------------
+
+def _divisors(n: int) -> tuple:
+    return tuple(d for d in range(1, n + 1) if n % d == 0)
+
+
+def enumerate_layouts(devices: int, model_cfg, seq: int,
+                      global_batch_examples: int, mb_rows: int,
+                      max_tp: int = 8, max_sp: int = 4) -> list[dict]:
+    """Every (pp, tp, dp, sp) mesh of EXACTLY `devices` chips the model and
+    batch shape admit, each with its microbatch count at the PRESERVED
+    global batch (the elastic data contract: a dp change is compensated in
+    gradient_accumulation_steps, never in examples/step) and its stage
+    partition (even where layers divide, StageManifest.balanced counts
+    where they don't — the unequal-stage lever SkipPipe/MPMD-PP open).
+
+    The divisibility rules mirror the trainer's own validation
+    (parallel/pipeline.py make_pipeline_loss_and_grad, mesh.MeshConfig):
+    anything emitted here must survive the launch line."""
+    from llama_pipeline_parallel_tpu.models.llama.manifest import StageManifest
+
+    layouts = []
+    for pp in _divisors(devices):
+        if pp > model_cfg.num_hidden_layers:
+            continue
+        for tp in _divisors(devices // pp):
+            if tp > max_tp:
+                continue
+            if (model_cfg.num_attention_heads % tp
+                    or model_cfg.kv_heads % tp
+                    or model_cfg.intermediate_size % tp
+                    or model_cfg.vocab_size % tp):
+                continue
+            for sp in _divisors(devices // (pp * tp)):
+                if sp > max_sp or seq % sp:
+                    continue
+                dp = devices // (pp * tp * sp)
+                micro, rem = divmod(global_batch_examples, mb_rows * dp)
+                if rem or micro < 1:
+                    continue
+                if model_cfg.num_hidden_layers % pp == 0:
+                    counts = None
+                else:
+                    counts = StageManifest.balanced(
+                        model_cfg, pp).stage_layer_counts
+                layouts.append({"pp": pp, "tp": tp, "dp": dp, "sp": sp,
+                                "microbatches": micro,
+                                "layer_counts": counts})
+    return layouts
+
+
+def layout_device_gib(model_cfg, pp: int, tp: int, dp: int,
+                      layer_counts: tuple | None = None,
+                      optimizer_offload: bool = True,
+                      zero2: bool = True) -> float:
+    """Schedule-INDEPENDENT analytic device memory of a layout, GiB: the
+    bf16 working params of one stage's (padded) layer slots at the tp
+    shard width plus the replicated embed / final norm / vocab-parallel
+    lm-head, the fp32 gradient trees the step holds live (accumulator +
+    per-tick grads + returned grads — the returned tree dp-sharded under
+    ZeRO-2's reduce-scatter), and — on the fused path — the fp32 masters +
+    dp-sharded Adam moments. The schedule-dependent ring/stash/loss-head
+    terms are NOT here: candidate_device_terms_gib adds them per schedule
+    candidate, exactly as the fixed-mesh selection does.
+
+    This is a model, not a compile: --select calibrates it against the one
+    compiled peak it already paid for (the residual covers transient
+    activations and XLA slack, scaled to each layout's per-tick work) and
+    the verdicts inherit the usual CPU-estimate caveat."""
+    import numpy as np
+
+    d = model_cfg.hidden_size
+    kv_dim = model_cfg.kv_heads * model_cfg.head_dim
+    matmul = (2 * d * d + 2 * d * kv_dim
+              + 3 * d * model_cfg.intermediate_size)
+    k_max = (max(layer_counts) if layer_counts
+             else -(-model_cfg.num_hidden_layers // pp))
+    stage = k_max * (matmul / tp + 2 * d)
+    shared = (model_cfg.vocab_size * d            # embed, replicated
+              + model_cfg.vocab_size * d / tp     # lm-head, vocab-parallel
+              + d)                                # final norm
+    n = stage + shared
+    dtype_b = np.dtype(model_cfg.dtype).itemsize
+    weights = n * dtype_b
+    if optimizer_offload:
+        grads = n * 4 * (2 + (1.0 / dp if zero2 else 1.0))
+        opt = 0.0
+    else:
+        grads = n * 4 * 2
+        opt = n * 4 + n * 8 / dp  # fp32 masters + ZeRO-1 dp-sharded moments
+    return (weights + grads + opt) / (1 << 30)
+
+
+def layout_step_seconds(model_cfg, lay: dict, bubble: float, mb_rows: int,
+                        seq: int, mfu: float, chip_flops: float | None,
+                        ici_bw_gibps: float, zero2: bool = True) -> float:
+    """Analytic per-step seconds of a layout running its chosen schedule —
+    the RANKING score of the frontier (absolute accuracy is not the point;
+    bench.py's extra:layout-* rows put the measured number next to it):
+
+      compute/(1-bubble)           the lockstep pipeline wall (compute is
+                                   layout-invariant at fixed devices — the
+                                   whole model's flops spread over all
+                                   chips — so bubble and collectives are
+                                   what separate layouts)
+    + tp allreduces                4 per layer per microbatch of the
+                                   [mb, seq/sp, d] block (Megatron f/g),
+                                   ring-allreduce 2(tp-1)/tp bytes
+    + dp gradient reduction        the stage's fp32 grads, reduce-scatter
+                                   (dp-1)/dp under ZeRO-2, allreduce
+                                   2(dp-1)/dp otherwise
+    + pp ring handoff              one [mb, seq/sp, d] slab per unit each
+                                   direction
+    + sp ring-attention rotation   (sp-1) k/v-slab hops per layer per
+                                   microbatch, ~3x for fwd+bwd
+
+    Collectives are charged SERIALLY at --ici-bw-gibps — conservative (XLA
+    overlaps some of them), which is the right bias for a ranking that
+    must not over-promise exotic layouts."""
+    import numpy as np
+
+    from llama_pipeline_parallel_tpu.utils.metrics import (
+        detect_chip_peak_flops,
+        train_flops_per_token,
+    )
+
+    pp, tp, dp, sp = lay["pp"], lay["tp"], lay["dp"], lay["sp"]
+    micro = lay["microbatches"]
+    devices = pp * tp * dp * sp
+    peak = chip_flops or detect_chip_peak_flops() or 197e12
+    tokens = mb_rows * micro * dp * seq
+    t_comp = (train_flops_per_token(model_cfg, seq) * tokens / devices
+              / (peak * max(mfu, 1e-6)))
+    wall = t_comp / max(1.0 - bubble, 1e-6)
+
+    d = model_cfg.hidden_size
+    dtype_b = np.dtype(model_cfg.dtype).itemsize
+    bw = ici_bw_gibps * (1 << 30)
+    slab = mb_rows * (seq // sp) * d * dtype_b
+    counts = lay.get("layer_counts")
+    k_max = max(counts) if counts else -(-model_cfg.num_hidden_layers // pp)
+    t_tp = (2 * (tp - 1) / tp) * 4 * k_max * micro * slab / bw if tp > 1 \
+        else 0.0
+    kv_dim = model_cfg.kv_heads * model_cfg.head_dim
+    matmul = 2 * d * d + 2 * d * kv_dim + 3 * d * model_cfg.intermediate_size
+    stage_grads = k_max * (matmul / tp) * 4
+    dp_factor = (dp - 1) / dp if zero2 else 2 * (dp - 1) / dp
+    t_dp = dp_factor * stage_grads / bw if dp > 1 else 0.0
+    t_pp = 2 * micro * slab / bw if pp > 1 else 0.0
+    kv_slab = 2 * mb_rows * (seq // sp) * kv_dim * dtype_b
+    t_sp = 3 * (sp - 1) * k_max * micro * kv_slab / bw if sp > 1 else 0.0
+    return wall + t_tp + t_dp + t_pp + t_sp
+
+
+def layout_frontier(model_cfg, devices: int, mb_rows: int, seq: int,
+                    global_batch_examples: int, base_gib_aw: float,
+                    aw_layout: tuple, hbm_gb: float,
+                    host_bw_gibps: float = 30.0, mfu: float = 0.45,
+                    chip_flops: float | None = None,
+                    ici_bw_gibps: float = 90.0, hide_max: float = 1.0,
+                    optimizer_offload: bool = True, zero2: bool = True,
+                    loss_chunks_aw: int = 1, vocab_enabled: bool = True,
+                    solver_lane: bool = True,
+                    max_virtual: int = 4) -> tuple:
+    """The full (pp, tp, dp, sp) frontier at `devices` chips: per layout,
+    re-run the schedule/offload/ce selection against the memory model at
+    THAT mesh (base re-derived analytically, calibrated by the residual
+    between the as-written layout's compiled base `base_gib_aw` and its
+    analytic model; the residual — transients + XLA slack — scales with
+    each layout's per-tick tp/sp shard width), then rank the feasible
+    survivors by layout_step_seconds. Returns (winner_row, rows) ordered
+    best-first. Pure arithmetic: the one compile was already paid for."""
+    from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+    from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig
+
+    pp_aw, tp_aw, dp_aw, sp_aw = aw_layout
+    residual = base_gib_aw - layout_device_gib(
+        model_cfg, pp_aw, tp_aw, dp_aw,
+        optimizer_offload=optimizer_offload, zero2=zero2)
+    rows = []
+    for lay in enumerate_layouts(devices, model_cfg, seq,
+                                 global_batch_examples, mb_rows):
+        pp, tp, dp, sp = lay["pp"], lay["tp"], lay["dp"], lay["sp"]
+        micro = lay["microbatches"]
+        dims = pl.stash_dims(mb_rows, seq, sp, model_cfg.hidden_size,
+                             model_cfg.dtype)
+        base = (layout_device_gib(model_cfg, pp, tp, dp,
+                                  layer_counts=lay["layer_counts"],
+                                  optimizer_offload=optimizer_offload,
+                                  zero2=zero2)
+                + residual * (tp_aw * sp_aw) / (tp * sp))
+        ce_axis = (ce_axis_options(loss_chunks_aw, model_cfg.vocab_size, tp)
+                   if vocab_enabled else None)
+        vocab = (model_cfg.vocab_size if vocab_enabled and tp <= 1 else None)
+        cands = enumerate_candidates(pp, micro, model_cfg.num_hidden_layers,
+                                     max_virtual=max_virtual,
+                                     ce_options=ce_axis,
+                                     layer_counts=lay["layer_counts"])
+        if solver_lane and lay["layer_counts"] is None:
+            solver_head = 0.0
+            if vocab:
+                solver_head = pl.loss_head_bytes(
+                    pl.PipelineConfig(num_stages=pp, num_microbatches=micro),
+                    *dims[:3], vocab) / (1 << 30)
+            cands += solver_candidates(pp, micro,
+                                       model_cfg.num_hidden_layers, base,
+                                       dims, hbm_gb, max_virtual=max_virtual,
+                                       head_gib=solver_head)
+        mesh_cfg = MeshConfig(pp=pp, tp=tp, dp=dp, sp=sp)
+        compute_fn = lambda c, _mc=mesh_cfg: _step_compute_seconds(
+            model_cfg, _mc, c, mb_rows, seq, mfu, chip_flops)
+        sched_winner, _ = select_schedule(cands, base, dims, hbm_gb,
+                                          host_bw_gibps, compute_fn,
+                                          hide_max=hide_max, vocab=vocab)
+        row = {"pp": pp, "tp": tp, "dp": dp, "sp": sp,
+               "layout": f"pp{pp}xtp{tp}xdp{dp}xsp{sp}",
+               "microbatches": micro,
+               "layer_counts": (list(lay["layer_counts"])
+                                if lay["layer_counts"] else None),
+               "base_gib": round(base, 2)}
+        if sched_winner is None:
+            row.update({"feasible": False, "score_s": None,
+                        "why_not": "no schedule fits this layout's memory "
+                                   "model"})
+        else:
+            score = layout_step_seconds(model_cfg, lay,
+                                        sched_winner["bubble_fraction"],
+                                        mb_rows, seq, mfu, chip_flops,
+                                        ici_bw_gibps, zero2=zero2)
+            row.update({"feasible": True, "score_s": round(score, 4),
+                        "_score": score,
+                        "why_not": None, "sched": sched_winner,
+                        "est_peak_gib": sched_winner["est_peak_gib"],
+                        "bubble_fraction": sched_winner["bubble_fraction"]})
+        rows.append(row)
+    rows.sort(key=lambda r: (not r["feasible"],
+                             r.get("_score", float("inf")), r["layout"]))
+    winner = rows[0] if rows and rows[0]["feasible"] else None
+    return winner, rows
+
+
+def layout_overrides(row: dict, schedule_file: str | None = None) -> list:
+    """One frontier row as the override LIST a supervisor ladder rung (or
+    an operator's launch line) appends to the training command — the mesh
+    axes, the preserved-global-batch microbatch count, the explicit stage
+    partition when uneven, and the chosen schedule's own overrides. Every
+    string here must round-trip train.py's config validation
+    (tests/test_layout_select.py pins the grid)."""
+    parts = [f"mesh.pp={row['pp']}", f"mesh.tp={row['tp']}",
+             f"mesh.dp={row['dp']}", f"mesh.sp={row['sp']}",
+             f"gradient_accumulation_steps={row['microbatches']}"]
+    if row.get("layer_counts"):
+        parts.append("layer_counts=[" +
+                     ",".join(str(c) for c in row["layer_counts"]) + "]")
+    parts += select_overrides(row["sched"], schedule_file=schedule_file).split()
+    return parts
+
+
+def build_ladder(model_cfg, devices: int, mb_rows: int, seq: int,
+                 global_batch_examples: int, base_gib_aw: float,
+                 aw_layout: tuple, hbm_gb: float, top_k: int = 3,
+                 schedule_file_for=None, **frontier_kw) -> tuple:
+    """The generated supervisor ladder: the top-k frontier survivors at
+    `devices` chips, then the single best survivor at each HALVED device
+    count (the elastic-resize rungs: lose half the pod, walk down a rung,
+    keep the global batch) — best-first, tools/supervisor.py's
+    --layout-ladder format verbatim ({name, devices, overrides}).
+    `schedule_file_for(rung_name, pcfg) -> path` serializes a solver
+    winner's unit sequence and returns the path its rung references (None
+    = restrict rungs to canonical schedules). Returns (rungs, frontiers)
+    where frontiers maps device count -> the scored rows."""
+    rungs, frontiers = [], {}
+    n = devices
+    while n >= 1:
+        kw = dict(frontier_kw)
+        if schedule_file_for is None:
+            kw["solver_lane"] = False  # a solver rung needs its sequence file
+        _, rows = layout_frontier(model_cfg, n, mb_rows, seq,
+                                  global_batch_examples, base_gib_aw,
+                                  aw_layout, hbm_gb, **kw)
+        frontiers[n] = rows
+        feasible = [r for r in rows if r["feasible"]]
+        for r in feasible[:top_k if n == devices else 1]:
+            name = f"{r['layout']}-{r['sched']['schedule']}"
+            if any(rg["name"] == name for rg in rungs):
+                name += f"-c{r['sched']['accum_chunks']}"
+            sfile = None
+            if r["sched"]["schedule"] == "solver":
+                sfile = schedule_file_for(name, r["sched"]["_pcfg"])
+            rungs.append({"name": name, "devices": n,
+                          "overrides": layout_overrides(
+                              r, schedule_file=sfile)})
+        if n == 1:
+            break
+        n //= 2
+    return rungs, frontiers
 
 
 def select_overrides(row: dict, schedule_file: str | None = None) -> str:
@@ -852,6 +1191,22 @@ def resume_compat(cfg: dict) -> dict | None:
                "virtual_stages": int(cfg.get("virtual_stages", 1) or 1)}
     report: dict = {"resume_step": latest}
     source = meta.get("topology")
+    if source and "layer_counts" in source:
+        # mirror the trainer's partition-aware restore labeling: a ladder
+        # rung that changes layer_counts is a topology change here too
+        try:
+            from llama_pipeline_parallel_tpu.train import (
+                build_manifest,
+                build_model_config,
+            )
+
+            man = build_manifest(cfg, build_model_config(cfg["model"]),
+                                 current["pp"])
+            current["layer_counts"] = (
+                f"even/{man.stage_layer_counts[0]}" if man.is_even
+                else list(man.stage_layer_counts))
+        except Exception:
+            pass  # unresolvable model node: skip the partition comparison
     if source:
         changed = sorted(k for k in current if source.get(k) != current[k])
         report["source_topology"] = source.get("layout", source)
@@ -950,6 +1305,27 @@ def main(argv: list[str] | None = None) -> None:
                         "per-stage ASCII timeline — debug a refused or "
                         "surprising schedule without a TPU; the file feeds "
                         "pipeline_schedule: solver + schedule_file")
+    p.add_argument("--layout-devices", type=int, default=None, metavar="N",
+                   help="with --select: grow the OUTER (pp, tp, dp, sp) "
+                        "axes — enumerate every mesh of N devices (default: "
+                        "the as-written world size), re-run the memory "
+                        "model + schedule selection per mesh, and rank the "
+                        "frontier by the analytic step-time score "
+                        "(docs/PREFLIGHT.md 'Layout auto-selection')")
+    p.add_argument("--emit-ladder", default=None, metavar="PATH",
+                   help="with --select: write the layout frontier's top-k "
+                        "survivors (plus the best rung at each halved "
+                        "device count — the elastic-resize rungs) as a "
+                        "tools/supervisor.py --layout-ladder JSON; solver "
+                        "rungs get their unit-sequence files written next "
+                        "to PATH")
+    p.add_argument("--ladder-top-k", type=int, default=3,
+                   help="rungs to emit at the full device count (default "
+                        "3 — the set bench.py's extra:layout-* rows "
+                        "measure)")
+    p.add_argument("--ici-bw-gibps", type=float, default=90.0,
+                   help="assumed ICI per-link bandwidth, GiB/s, for the "
+                        "layout score's collective terms (v5p ~90)")
     p.add_argument("--host-bw-gibps", type=float, default=30.0,
                    help="assumed host-link bandwidth, GiB/s, for the "
                         "offload feasibility bound (measure the real one "
@@ -976,6 +1352,10 @@ def main(argv: list[str] | None = None) -> None:
 
         print(json.dumps(calibrate(), indent=2))
         return
+    if (args.emit_ladder or args.layout_devices) and not args.select:
+        p.error("--emit-ladder/--layout-devices extend --select (the layout "
+                "lane calibrates against the compiled peak --select anchors "
+                "on)")
     if args.all_globs is not None:
         if args.config:
             p.error("--config and --all are mutually exclusive")
@@ -1085,7 +1465,8 @@ def _print_selection(cfg: dict, report: dict, args) -> None:
                               mesh_cfg.tp)
     candidates = enumerate_candidates(mesh_cfg.pp, pcfg.num_microbatches,
                                       model_cfg.num_hidden_layers,
-                                      ce_options=ce_axis)
+                                      ce_options=ce_axis,
+                                      layer_counts=pcfg.layer_counts)
     # the solver lane: list-scheduled sequences with budget-sized per-unit
     # offload vectors, scored in the SAME pass under the same constraints
     # (incl. the dense loss-head term solver rows are charged — they carry
@@ -1097,9 +1478,15 @@ def _print_selection(cfg: dict, report: dict, args) -> None:
         solver_head = pl.loss_head_bytes(
             _dc.replace(pcfg, loss_chunks=1, kernel_ce=False),
             *dims[:3], vocab) / (1 << 30)
-    candidates += solver_candidates(mesh_cfg.pp, pcfg.num_microbatches,
-                                    model_cfg.num_hidden_layers, base, dims,
-                                    args.hbm_gb, head_gib=solver_head)
+    if pcfg.layer_counts is None or len(set(pcfg.layer_counts)) == 1:
+        # the solver lane emits even sequences; on an unequal as-written
+        # partition its rows would be scored with uncosted bubbles and
+        # unfairly beat the canonical candidates — skip it there (the
+        # layout lane already skips uneven layouts the same way)
+        candidates += solver_candidates(mesh_cfg.pp, pcfg.num_microbatches,
+                                        model_cfg.num_hidden_layers, base,
+                                        dims, args.hbm_gb,
+                                        head_gib=solver_head)
     winner, rows = select_schedule(
         candidates, base, dims, args.hbm_gb, args.host_bw_gibps, compute_fn,
         hide_max=args.hide_ratio_max, vocab=vocab)
@@ -1130,6 +1517,12 @@ def _print_selection(cfg: dict, report: dict, args) -> None:
               f"{r['loss_head_gib']:>9} "
               f"{100 * r['bubble_fraction']:>8.2f} {r['hide_ratio']:>6} "
               f" {'OK' if r['feasible'] else r['why_not']}")
+    if args.layout_devices or args.emit_ladder:
+        # the OUTER axes: every (pp, tp, dp, sp) mesh of the device count,
+        # each re-scored by the same memory model — runs even when nothing
+        # fits the as-written mesh (another layout may be the fix)
+        _print_layout_frontier(cfg, args, model_cfg, mesh_cfg, pcfg, base,
+                               mb_rows, seq)
     if winner is None:
         print("selection: NO feasible candidate — grow the mesh (tp/pp) or "
               "shrink the batch shape")
@@ -1147,6 +1540,88 @@ def _print_selection(cfg: dict, report: dict, args) -> None:
           f"(est peak {winner['est_peak_gib']} GiB, bubble "
           f"{100 * winner['bubble_fraction']:.2f}%, host stash "
           f"{winner['host_stash_gib']} GiB)")
+
+
+def _print_layout_frontier(cfg: dict, args, model_cfg, mesh_cfg, pcfg,
+                           base: float, mb_rows: int, seq: int) -> None:
+    """The layout lane of --select: print the scored (pp, tp, dp, sp)
+    frontier and — with --emit-ladder — write the generated supervisor
+    ladder (+ any solver rungs' unit-sequence files). Pure arithmetic on
+    top of the one compile the as-written report paid for."""
+    import json as _json
+
+    devices = args.layout_devices or mesh_cfg.world_size
+    g_examples = mb_rows * pcfg.num_microbatches * mesh_cfg.dp
+    aw_layout = (mesh_cfg.pp, mesh_cfg.tp, mesh_cfg.dp, mesh_cfg.sp)
+    kw = dict(host_bw_gibps=args.host_bw_gibps, mfu=args.mfu,
+              chip_flops=args.chip_flops, ici_bw_gibps=args.ici_bw_gibps,
+              hide_max=args.hide_ratio_max,
+              optimizer_offload=bool(cfg.get("optimizer_offload")),
+              zero2=bool(cfg.get("optimizer_offload_zero2")),
+              loss_chunks_aw=pcfg.loss_chunks)
+    # the display frontier ranks LAYOUTS, and the layout score depends on
+    # the bubble, not on where the W residuals live — the canonical lane
+    # ranks identically, so the solver refinement (slower: a per-unit
+    # binary search per grid point) is saved for the ladder's actual rungs
+    winner, rows = layout_frontier(model_cfg, devices, mb_rows, seq,
+                                   g_examples, base, aw_layout, args.hbm_gb,
+                                   solver_lane=False, **kw)
+    print(f"layout frontier ({devices} devices, global batch {g_examples} "
+          f"examples preserved; analytic memory model calibrated on the "
+          f"compiled as-written peak, score = compute/(1-bubble) + "
+          f"collectives at {args.ici_bw_gibps} GiB/s ICI):")
+    print(f"  {'layout':<20} {'M':>4} {'partition':<14} {'schedule':<17} "
+          f"{'v':>2} {'c':>2} {'peak GiB':>9} {'bubble%':>8} "
+          f"{'score s':>8}  verdict")
+    for r in rows:
+        part = ("even" if not r["layer_counts"]
+                else ",".join(str(c) for c in r["layer_counts"]))
+        mark = "*" if r is winner else " "
+        if r["feasible"]:
+            s = r["sched"]
+            name = s.get("label") or s["schedule"]
+            print(f" {mark}{r['layout']:<20} {r['microbatches']:>4} "
+                  f"{part:<14} {name:<17} {s['virtual_stages']:>2} "
+                  f"{s['accum_chunks']:>2} {r['est_peak_gib']:>9} "
+                  f"{100 * r['bubble_fraction']:>8.2f} {r['score_s']:>8}  OK")
+        else:
+            print(f" {mark}{r['layout']:<20} {r['microbatches']:>4} "
+                  f"{part:<14} {'-':<17} {'-':>2} {'-':>2} "
+                  f"{r['base_gib']:>9} {'-':>8} {'-':>8}  {r['why_not']}")
+    if winner is not None:
+        print(f"layout selected: {winner['layout']} "
+              f"(score {winner['score_s']} s, est peak "
+              f"{winner['est_peak_gib']} GiB) — overrides: "
+              f"{' '.join(layout_overrides(winner))}")
+    else:
+        print("layout selection: NO feasible layout at this device count — "
+              "shrink the batch shape or raise --hbm-gb")
+    if args.emit_ladder:
+        stem = args.emit_ladder
+        if stem.endswith(".json"):
+            stem = stem[: -len(".json")]
+
+        def schedule_file_for(rung_name: str, rung_pcfg) -> str:
+            from llama_pipeline_parallel_tpu.parallel import schedule as usched
+
+            path = f"{stem}-{rung_name}.schedule.json"
+            with open(path, "w") as fh:
+                fh.write(usched.to_json(rung_pcfg.unit_schedule))
+            return path
+
+        rungs, _ = build_ladder(model_cfg, devices, mb_rows, seq,
+                                g_examples, base, aw_layout, args.hbm_gb,
+                                top_k=args.ladder_top_k,
+                                schedule_file_for=schedule_file_for, **kw)
+        with open(args.emit_ladder, "w") as fh:
+            _json.dump(rungs, fh, indent=1)
+            fh.write("\n")
+        print(f"emitted ladder -> {args.emit_ladder} ({len(rungs)} rungs, "
+              f"best-first; feed it to tools/supervisor.py "
+              f"--layout-ladder @{args.emit_ladder}):")
+        for rg in rungs:
+            print(f"  {rg['devices']:>5} devices  {rg['name']:<28} "
+                  f"{' '.join(rg['overrides'])}")
 
 
 def _emit_schedule(path: str, winner_pcfg, row: dict | None, pp: int,
