@@ -775,6 +775,123 @@ def main() -> None:
                 print(f"bench serving rows failed: {e!r}", file=sys.stderr,
                       flush=True)
 
+            # Paged-KV rows (docs/SERVING.md "Paged KV cache"): the SAME
+            # steady-state decode measurement through the paged engine (fp
+            # and int8 pages), each row carrying the pool-vs-dense resident
+            # byte model NEXT to the measured per-token time — the dense
+            # `extra:serve-decode` row above is the twin, so one live TPU
+            # run lands the paged-gather cost and the int8 capacity
+            # doubling as measured deltas. Separate try: a paged failure
+            # must not eat the dense rows already recorded.
+            try:
+                from llama_pipeline_parallel_tpu.serve.pages import (
+                    dense_kv_cache_bytes,
+                    paged_pool_bytes,
+                )
+
+                slots = int(os.environ.get("BENCH_SERVE_SLOTS", "8"))
+                decode_steps = int(os.environ.get("BENCH_SERVE_STEPS", "32"))
+                budget = decode_steps + 8
+                page = 16
+                # bucket rounded DOWN to a page multiple (paged buckets
+                # must be page-aligned; a seq that isn't must not silently
+                # drop these rows)
+                p_len = max(page, min(128, seq) // page * page)
+                max_len_p = -(-(p_len + budget + 1) // page) * page
+                dense_twin = results.get(f"extra:serve-decode,bs={slots}")
+                dense_mib = dense_kv_cache_bytes(cfg, slots,
+                                                 max_len_p) / (1 << 20)
+                rs = np.random.RandomState(0)
+                prompt = rs.randint(3, cfg.vocab_size, (p_len,)).tolist()
+                for quant in ("fp", "int8"):
+                    scfg = ServeConfig(
+                        max_slots=slots, max_len=max_len_p,
+                        prompt_buckets=(p_len,), max_queue=4 * slots,
+                        kv_cache="paged", page_size=page, kv_quant=quant)
+                    eng = ServeEngine(pl.unstack_stages(stacked, manifest),
+                                      cfg, scfg)
+                    for _ in range(slots):
+                        eng.submit(ServeRequest(
+                            input_ids=prompt,
+                            gen=GenerationConfig(max_new_tokens=budget)))
+                    eng.step()  # admissions + first tick (compiles)
+                    t0 = time.perf_counter()
+                    for _ in range(decode_steps):
+                        eng.step()
+                    dt = (time.perf_counter() - t0) / decode_steps
+                    detail = {
+                        "per_token_ms": round(1000 * dt / slots, 3),
+                        "step_ms": round(1000 * dt, 2), "slots": slots,
+                        "page_size": page,
+                        "pages_used": eng.slots.pages_used,
+                        "pages_total": eng.slots.num_pages,
+                        "pool_mib": round(paged_pool_bytes(
+                            cfg, scfg.resolved_num_pages, page,
+                            quant) / (1 << 20), 2),
+                        "dense_cache_mib": round(dense_mib, 2),
+                        "kv_quant": quant}
+                    if dense_twin is not None:
+                        detail["dense_step_ms"] = round(
+                            1000 * dense_twin["dt"], 2)
+                    tag = "-int8" if quant == "int8" else ""
+                    results[f"extra:serve-paged{tag}-decode,bs={slots}"] = {
+                        "dt": dt, "tokens_per_step": slots,
+                        "headline": False, "detail": detail}
+                    eng.shutdown()
+            except Exception as e:
+                print(f"bench paged serving rows failed: {e!r}",
+                      file=sys.stderr, flush=True)
+
+            # Chunked-prefill row: the synthetic traffic generator
+            # (tools/serve_traffic.py — Poisson arrivals, prompt/output
+            # length mixes) replayed against a paged engine with a bounded
+            # per-tick prefill budget; the row's metadata records the mix
+            # that generated the load, and the SLO percentiles are what
+            # interleaved admissions cost in-flight decodes.
+            try:
+                sys.path.insert(0, os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "tools"))
+                import serve_traffic as _tr
+
+                p_small = max(16, min(64, seq) // 16 * 16)  # page-aligned
+                chunk = p_small
+                max_len_t = 4 * p_small
+                prompt_mix = _tr.parse_mix(f"{p_small}:0.6,{2 * p_small}:0.4")
+                output_mix = _tr.parse_mix("8:0.5,16:0.5")
+                rate = float(os.environ.get("BENCH_TRAFFIC_RATE", "16"))
+                n_req = int(os.environ.get("BENCH_TRAFFIC_REQUESTS", "12"))
+                eng = ServeEngine(
+                    pl.unstack_stages(stacked, manifest), cfg,
+                    ServeConfig(
+                        max_slots=4, max_len=max_len_t,
+                        prompt_buckets=(p_small, 2 * p_small),
+                        max_queue=4 * n_req, kv_cache="paged",
+                        page_size=16, prefill_chunk_tokens=chunk))
+                trace_reqs = _tr.poisson_trace(0, rate, n_req, prompt_mix,
+                                               output_mix)
+                summary = _tr.run_trace(eng, trace_reqs)
+                eng.shutdown()
+                results["extra:serve-prefill-chunked"] = {
+                    "dt": summary["wall_s"],
+                    "tokens_per_step": summary.get("tokens_generated", 0),
+                    "headline": False, "detail": {
+                        "mix": {"prompt": _tr.mix_label(prompt_mix),
+                                "output": _tr.mix_label(output_mix),
+                                "rate_rps": rate, "seed": 0,
+                                "requests": n_req},
+                        "chunk_tokens": chunk, **{
+                            k: summary[k] for k in (
+                                "requests_completed", "refused_pages",
+                                "refused_overload", "tokens_per_sec",
+                                "prefill_chunks_total",
+                                "prefill_tokens_total")
+                            if k in summary},
+                        **{k: summary[k] for k in summary
+                           if k.startswith(("ttft_", "tpot_"))}}}
+            except Exception as e:
+                print(f"bench prefill traffic row failed: {e!r}",
+                      file=sys.stderr, flush=True)
+
     summary = report()
     watchdog.cancel()
     if summary is None:

@@ -390,3 +390,307 @@ def write_slot(cache: dict, kv_mask: jnp.ndarray, slot: jnp.ndarray,
     }
     kv_mask = jax.lax.dynamic_update_slice(kv_mask, row_kv_mask, (slot, 0))
     return cache, kv_mask
+
+
+# -- paged continuous-batching entry points (serve/pages.py) ------------------
+#
+# The slot cache above reserves `[max_slots, max_len]` rows up front: one
+# long request's worst case is charged to EVERY slot. The paged variants
+# below keep the same static-shape discipline (one compile per program, no
+# per-batch retracing) but back the logical rows with fixed-size PAGES from
+# a shared pool plus a slot->page table, so resident HBM tracks tokens
+# actually written. The logical view a slot sees is still `[max_len]` =
+# `pages_per_slot * page_size` — the gather below reconstitutes it per
+# layer — which is what makes the fp paged decode token-bit-exact against
+# the dense path: post-mask score arrays are identical (garbage pages only
+# ever contribute through masked positions, whose scores are the same
+# NEG_INF constant and whose softmax weights are exactly 0.0).
+#
+# int8 pages (`quant="int8"`) store one fp32 scale per (layer, page,
+# kv_head): prefill writes whole pages and set the scale from the block
+# absmax; decode writes claim a fresh page at offset 0 (pages fill in
+# strict logical order) and set its scale from the first token, later
+# offsets saturate against it. Dequantization happens on read, in fp32,
+# before the cast to the compute dtype — serve/engine.py tolerance-gates
+# this path instead of claiming bit parity.
+
+
+def init_page_pool(cfg: LlamaConfig, num_pages: int, page_size: int,
+                   quant: str = "fp") -> dict:
+    """Zeroed page pool. k/v: [n_layers, num_pages + 1, page_size, kv_h, hd]
+    — ONE extra garbage page at index `num_pages`: released/inactive slots
+    point every logical page at it, so their rides through the static-shape
+    decode step scatter there instead of into live data. int8 pools carry
+    k_scale/v_scale: [n_layers, num_pages + 1, kv_h] fp32 per-page scales."""
+    shape = (cfg.num_hidden_layers, num_pages + 1, page_size, cfg.kv_heads,
+             cfg.head_dim)
+    dt = jnp.int8 if quant == "int8" else cfg.dtype
+    pool = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    if quant == "int8":
+        sshape = (cfg.num_hidden_layers, num_pages + 1, cfg.kv_heads)
+        pool["k_scale"] = jnp.zeros(sshape, jnp.float32)
+        pool["v_scale"] = jnp.zeros(sshape, jnp.float32)
+    return pool
+
+
+# absmax floor: an all-zero block quantizes against this instead of 0/0
+_SCALE_FLOOR = 1e-8
+
+
+def quant_page_block(x: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """fp -> int8 against a per-(page, kv_head) scale (broadcast over the
+    page and head_dim axes). Saturating: values beyond the scale clip."""
+    q = jnp.round(x.astype(jnp.float32) * (127.0 / scale))
+    return jnp.clip(q, -127, 127).astype(jnp.int8)
+
+
+def dequant_page_block(q: jnp.ndarray, scale: jnp.ndarray,
+                       dtype) -> jnp.ndarray:
+    """int8 -> fp32 dequant against the per-page scale, then the compute-
+    dtype cast (the 'fp32 dequant-on-read' half of the contract)."""
+    return (q.astype(jnp.float32) * (scale / 127.0)).astype(dtype)
+
+
+def _block_amax(x: jnp.ndarray, axes) -> jnp.ndarray:
+    return jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axes),
+                       _SCALE_FLOOR)
+
+
+def _gather_pages(pool_k, pool_v, sc_k, sc_v, page_table: jnp.ndarray,
+                  dtype):
+    """Reconstitute logical kv rows from the pool: [*, Pmax] page indices ->
+    [*, Pmax * page_size, kv_h, hd] in the compute dtype."""
+    gk = pool_k[page_table]
+    gv = pool_v[page_table]
+    if sc_k is not None:
+        gk = dequant_page_block(gk, sc_k[page_table][..., None, :, None], dtype)
+        gv = dequant_page_block(gv, sc_v[page_table][..., None, :, None], dtype)
+    *lead, pmax, page, kvh, hd = gk.shape
+    return (gk.reshape(*lead, pmax * page, kvh, hd),
+            gv.reshape(*lead, pmax * page, kvh, hd))
+
+
+@partial(jax.jit, donate_argnames=("pool", "kv_mask"))
+def write_pages(pool: dict, kv_mask: jnp.ndarray, slot: jnp.ndarray,
+                page_rows: jnp.ndarray, row_cache: dict,
+                row_kv_mask: jnp.ndarray) -> tuple[dict, jnp.ndarray]:
+    """Splice one prefilled request into its physical pages: the paged
+    counterpart of `write_slot`. `row_cache` is a `prefill_prompt` result
+    taken at max_len == the prompt bucket (k/v: [L, 1, bucket, kv_h, hd],
+    bucket a multiple of page_size), `page_rows` the [bucket / page_size]
+    physical pages the slot owns for it. The logical kv_mask row `slot` is
+    rewritten WHOLE (zeros past the bucket), so whatever a previous
+    occupant left in the row is dead after admission."""
+    L, _, bucket, kvh, hd = row_cache["k"].shape
+    n_pages = page_rows.shape[0]
+    page = bucket // n_pages
+    quant = pool["k"].dtype == jnp.int8
+    out = dict(pool)
+    for name in ("k", "v"):
+        blocks = row_cache[name].reshape(L, n_pages, page, kvh, hd)
+        if quant:
+            scale = _block_amax(blocks, axes=(2, 4))          # [L, n, kvh]
+            out[f"{name}_scale"] = out[f"{name}_scale"].at[:, page_rows].set(
+                scale)
+            blocks = quant_page_block(blocks, scale[:, :, None, :, None])
+        out[name] = out[name].at[:, page_rows].set(blocks)
+    lmax = kv_mask.shape[1]
+    row = jnp.pad(row_kv_mask.astype(kv_mask.dtype),
+                  ((0, 0), (0, lmax - bucket)))
+    kv_mask = jax.lax.dynamic_update_slice(kv_mask, row, (slot, 0))
+    return out, kv_mask
+
+
+@jax.jit
+def reset_kv_mask_row(kv_mask: jnp.ndarray, slot: jnp.ndarray) -> jnp.ndarray:
+    """Zero logical row `slot` — chunked prefill writes the row
+    incrementally, so the previous occupant's mask must die up front (the
+    single-shot `write_pages` path overwrites the whole row instead)."""
+    zeros = jnp.zeros((1, kv_mask.shape[1]), kv_mask.dtype)
+    return jax.lax.dynamic_update_slice(kv_mask, zeros, (slot, 0))
+
+
+def _paged_write_token(pool_k, sc_k, x1: jnp.ndarray, w_page: jnp.ndarray,
+                       w_off: jnp.ndarray):
+    """Scatter one token's kv rows ([b, kv_h, hd]) into their pages. int8:
+    offset 0 claims the page and sets its scale from this token's absmax
+    (pages fill in strict logical order, so offset 0 == a fresh page);
+    later offsets saturate against the existing scale."""
+    if sc_k is None:
+        return pool_k.at[w_page, w_off].set(x1), None
+    amax = _block_amax(x1, axes=-1)                            # [b, kvh]
+    scale = jnp.where((w_off == 0)[:, None], amax,
+                      jnp.maximum(sc_k[w_page], _SCALE_FLOOR))
+    sc_k = sc_k.at[w_page].set(scale)
+    pool_k = pool_k.at[w_page, w_off].set(
+        quant_page_block(x1, scale[:, :, None]))
+    return pool_k, sc_k
+
+
+def _layer_decode_paged(layer: Params, x: jnp.ndarray, pool_k, pool_v,
+                        sc_k, sc_v, page_table: jnp.ndarray,
+                        w_page: jnp.ndarray, w_off: jnp.ndarray,
+                        kv_mask: jnp.ndarray, cos: jnp.ndarray,
+                        sin: jnp.ndarray, cfg: LlamaConfig):
+    """`_layer_decode_rowwise` over the page pool: write this token's kv
+    into (w_page, w_off), gather each slot's logical row from its pages,
+    attend mask-gated — same arithmetic, paged residency."""
+    b, s, d = x.shape
+    hd = cfg.head_dim
+    dt = cfg.dtype
+
+    hidden = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+    q = (hidden @ layer["attn"]["wq"].astype(dt)).reshape(b, s, -1, hd)
+    k = (hidden @ layer["attn"]["wk"].astype(dt)).reshape(b, s, -1, hd)
+    v = (hidden @ layer["attn"]["wv"].astype(dt)).reshape(b, s, -1, hd)
+    q, k = apply_rope(q, k, cos, sin)
+
+    pool_k, sc_k = _paged_write_token(pool_k, sc_k, k[:, 0], w_page, w_off)
+    pool_v, sc_v = _paged_write_token(pool_v, sc_v, v[:, 0], w_page, w_off)
+    gk, gv = _gather_pages(pool_k, pool_v, sc_k, sc_v, page_table, dt)
+
+    attn_out = attention(q, gk, gv, kv_mask, causal=False)
+    attn_out = attn_out.reshape(b, s, -1) @ layer["attn"]["wo"].astype(dt)
+    x = llama.mlp_block(layer, x + attn_out, cfg)
+    return x, pool_k, pool_v, sc_k, sc_v
+
+
+@partial(jax.jit, static_argnames=("cfg",),
+         donate_argnames=("pool", "kv_mask"))
+def paged_decode_step(params: Params, token: jnp.ndarray, pool: dict,
+                      page_table: jnp.ndarray, pos: jnp.ndarray,
+                      write_pos: jnp.ndarray, kv_mask: jnp.ndarray,
+                      active: jnp.ndarray, keys: jnp.ndarray,
+                      temperature: jnp.ndarray, top_k: jnp.ndarray,
+                      top_p: jnp.ndarray, cfg: LlamaConfig) -> dict:
+    """`decode_step` over the page pool: one tick over every slot row, with
+    kv residency resolved through `page_table` ([S, pages_per_slot] physical
+    page per logical page). `active`: [S] 0/1 — rows actually decoding.
+    Inactive rows still ride the static shape, but their kv writes are
+    steered to the garbage page and their kv_mask rows left untouched:
+    unlike the dense cache (where a non-occupant row is dead until
+    admission rewrites it whole), a paged slot can be MID-CHUNKED-PREFILL
+    during the tick, already owning live pages and live mask spans that a
+    stray write_pos=0 write would corrupt. The gathered logical view is
+    [S, pages_per_slot * page_size] == [S, max_len], so the fp path is
+    token-bit-exact against the dense `decode_step` (pinned in
+    tests/test_paged_serving.py); int8 pools dequantize on read and are
+    tolerance-gated instead."""
+    b = token.shape[0]
+    page = pool["k"].shape[2]
+    garbage = pool["k"].shape[1] - 1
+    # .max(): active rows mark write_pos valid (same as dense), inactive
+    # rows keep whatever their mask row already says
+    kv_mask = kv_mask.at[jnp.arange(b), write_pos].max(
+        active.astype(kv_mask.dtype))
+    w_page = jnp.take_along_axis(page_table, (write_pos // page)[:, None],
+                                 axis=1)[:, 0]
+    w_page = jnp.where(active > 0, w_page, garbage)
+    w_off = write_pos % page
+
+    x = llama.embed(params, token[:, None], cfg)
+    cos, sin = rope_cos_sin(pos[:, None], cfg.head_dim, cfg.rope_theta,
+                            dtype=cfg.dtype)
+    quant = pool["k"].dtype == jnp.int8
+    xs = ((params["layers"], pool["k"], pool["v"], pool["k_scale"],
+           pool["v_scale"]) if quant
+          else (params["layers"], pool["k"], pool["v"]))
+
+    def body(h, xs):
+        if quant:
+            layer, pk, pv, sk, sv = xs
+        else:
+            (layer, pk, pv), sk, sv = xs, None, None
+        h, pk, pv, sk, sv = _layer_decode_paged(
+            layer, h, pk, pv, sk, sv, page_table, w_page, w_off, kv_mask,
+            cos, sin, cfg)
+        return h, ((pk, pv, sk, sv) if quant else (pk, pv))
+
+    x, new = jax.lax.scan(body, x, xs)
+    x = llama.final_norm(params, x, cfg)
+    logits = llama.lm_head(params, x, cfg)[:, -1, :]
+
+    split = jax.vmap(jax.random.split)(keys)        # [b, 2, 2]
+    nxt = sample_rowwise(logits, temperature, top_k, top_p, split[:, 1])
+    new_pool = {"k": new[0], "v": new[1]}
+    if quant:
+        new_pool["k_scale"], new_pool["v_scale"] = new[2], new[3]
+    return {"token": nxt, "pool": new_pool, "kv_mask": kv_mask,
+            "keys": split[:, 0]}
+
+
+@partial(jax.jit, static_argnames=("cfg",),
+         donate_argnames=("pool", "kv_mask"))
+def paged_prefill_chunk(params: Params, input_ids: jnp.ndarray,
+                        attention_mask: jnp.ndarray, positions: jnp.ndarray,
+                        pool: dict, page_table_row: jnp.ndarray,
+                        slot: jnp.ndarray, kv_mask: jnp.ndarray,
+                        write_start: jnp.ndarray, cfg: LlamaConfig) -> dict:
+    """One bounded prefill chunk of slot `slot`: embed chunk tokens
+    ([1, C], C a multiple of page_size, logical span [write_start,
+    write_start + C)), write their kv into the slot's pages, and attend
+    each chunk position over the slot's FULL gathered logical row (history
+    pages + the chunk itself) with a causal offset — the incremental half
+    of chunked batched prefill. The engine interleaves these under the
+    per-tick token budget so in-flight decodes never stall behind a long
+    prompt. Returns the LAST position's fp32 logits (only the final chunk's
+    are consumed, to sample the request's first token)."""
+    _, C = input_ids.shape
+    page = pool["k"].shape[2]
+    hd = cfg.head_dim
+    dt = cfg.dtype
+    quant = pool["k"].dtype == jnp.int8
+
+    mask = attention_mask.astype(jnp.int32)
+    kv_mask = jax.lax.dynamic_update_slice(kv_mask, mask, (slot, write_start))
+    lmax = kv_mask.shape[1]
+    row_mask = jax.lax.dynamic_slice(kv_mask, (slot, 0), (1, lmax))
+
+    chunk_pages = page_table_row[write_start // page +
+                                 jnp.arange(C // page)]  # [C/page] physical
+
+    x = llama.embed(params, input_ids, cfg)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
+                            dtype=cfg.dtype)
+    xs = ((params["layers"], pool["k"], pool["v"], pool["k_scale"],
+           pool["v_scale"]) if quant
+          else (params["layers"], pool["k"], pool["v"]))
+
+    def body(h, xs):
+        if quant:
+            layer, pk, pv, sk, sv = xs
+        else:
+            (layer, pk, pv), sk, sv = xs, None, None
+        b, s, d = h.shape
+        hidden = rms_norm(h, layer["input_norm"], cfg.rms_norm_eps)
+        q = (hidden @ layer["attn"]["wq"].astype(dt)).reshape(b, s, -1, hd)
+        k = (hidden @ layer["attn"]["wk"].astype(dt)).reshape(b, s, -1, hd)
+        v = (hidden @ layer["attn"]["wv"].astype(dt)).reshape(b, s, -1, hd)
+        q, k = apply_rope(q, k, cos, sin)
+
+        kb = k[0].reshape(C // page, page, -1, hd)
+        vb = v[0].reshape(C // page, page, -1, hd)
+        if quant:
+            ks = _block_amax(kb, axes=(1, 3))                 # [C/page, kvh]
+            vs = _block_amax(vb, axes=(1, 3))
+            sk = sk.at[chunk_pages].set(ks)
+            sv = sv.at[chunk_pages].set(vs)
+            kb = quant_page_block(kb, ks[:, None, :, None])
+            vb = quant_page_block(vb, vs[:, None, :, None])
+        pk = pk.at[chunk_pages].set(kb)
+        pv = pv.at[chunk_pages].set(vb)
+
+        gk, gv = _gather_pages(pk, pv, sk, sv, page_table_row[None], dt)
+        attn_out = attention(q, gk, gv, row_mask, causal=True,
+                             q_offset=write_start)
+        attn_out = attn_out.reshape(b, s, -1) @ layer["attn"]["wo"].astype(dt)
+        h = llama.mlp_block(layer, h + attn_out, cfg)
+        return h, ((pk, pv, sk, sv) if quant else (pk, pv))
+
+    x, new = jax.lax.scan(body, x, xs)
+    x = llama.final_norm(params, x[:, -1:, :], cfg)
+    logits = llama.lm_head(params, x, cfg)
+    new_pool = {"k": new[0], "v": new[1]}
+    if quant:
+        new_pool["k_scale"], new_pool["v_scale"] = new[2], new[3]
+    return {"logits": logits[:, -1], "pool": new_pool, "kv_mask": kv_mask}

@@ -2,10 +2,13 @@
 
 The second workload next to training: the decode stack generalized from
 one-shot batches to a long-lived service — slot-managed static KV cache
-(slots.py), admission scheduler with continuous batching (engine.py),
-SLO telemetry (telemetry.py), and a stdlib HTTP front-end (frontend.py).
-`tools/serve.py` wraps it into a supervised process; `tools/
-serving_report.py` summarizes its telemetry offline.
+(slots.py) or the paged KV cache (pages.py: fixed-size pages + slot->page
+table, so HBM tracks tokens actually generated; optional int8 pages),
+admission scheduler with continuous batching and chunked batched prefill
+(engine.py), SLO telemetry (telemetry.py), and a stdlib HTTP front-end
+(frontend.py). `tools/serve.py` wraps it into a supervised process;
+`tools/serving_report.py` summarizes its telemetry offline;
+`tools/serve_traffic.py` generates synthetic Poisson traffic against it.
 """
 
 from llama_pipeline_parallel_tpu.serve.engine import (
@@ -16,13 +19,15 @@ from llama_pipeline_parallel_tpu.serve.engine import (
     ServeEngine,
     ServeLoop,
     ServeOverloaded,
+    ServePagesExhausted,
     ServeRequest,
 )
+from llama_pipeline_parallel_tpu.serve.pages import PagedKVCache
 from llama_pipeline_parallel_tpu.serve.slots import SlotKVCache
 from llama_pipeline_parallel_tpu.serve.telemetry import SLOStats
 
 __all__ = [
-    "EngineShutdown", "RequestHandle", "RequestRejected", "ServeConfig",
-    "ServeEngine", "ServeLoop", "ServeOverloaded", "ServeRequest",
-    "SlotKVCache", "SLOStats",
+    "EngineShutdown", "PagedKVCache", "RequestHandle", "RequestRejected",
+    "ServeConfig", "ServeEngine", "ServeLoop", "ServeOverloaded",
+    "ServePagesExhausted", "ServeRequest", "SlotKVCache", "SLOStats",
 ]

@@ -1,22 +1,29 @@
 """Continuous-batching inference engine (docs/SERVING.md).
 
-The admission/batch scheduler over the slot manager: requests enter a
-bounded FIFO wait queue (`submit`, thread-safe — overload raises
-`ServeOverloaded`, the backpressure signal the frontend maps to HTTP 429),
-and at every `step()` boundary the engine
+The admission/batch scheduler over the KV cache — the dense slot manager
+(`slots.SlotKVCache`) or the paged pool (`pages.PagedKVCache`, selected by
+`ServeConfig.kv_cache`): requests enter a bounded FIFO wait queue
+(`submit`, thread-safe — overload raises `ServeOverloaded`; on the paged
+cache, a worst-case page demand the pool cannot cover raises
+`ServePagesExhausted`, both mapped to HTTP 429 + Retry-After by the
+frontend), and at every `step()` boundary the engine
 
 1. **admits** queued requests into free slots — each admission left-pads
    the prompt to the smallest configured bucket, runs `prefill_prompt`
    (one compile per bucket), samples the request's FIRST token with its own
-   rng chain, and splices the row into the long-lived cache
-   (`SlotKVCache.admit`) — prefill-then-join;
-2. runs ONE `decode_step` over every slot (static shape, one compile) —
-   per-row write positions, rope positions, rng chains, and sampling knobs,
-   so requests at different depths and with different `GenerationConfig`s
-   share the tick;
+   rng chain, and splices the row into the long-lived cache — prefill-
+   then-join. On the paged cache with `prefill_chunk_tokens` set, a bucket
+   larger than the budget instead prefills INCREMENTALLY: at most that
+   many prompt tokens per tick (`paged_prefill_chunk`), so in-flight
+   decodes keep producing a token every tick — chunked batched prefill,
+   no full-prefill stall;
+2. runs ONE `decode_step`/`paged_decode_step` over every slot (static
+   shape, one compile) — per-row write positions, rope positions, rng
+   chains, and sampling knobs, so requests at different depths and with
+   different `GenerationConfig`s share the tick;
 3. distributes the sampled tokens to their streaming handles and frees the
-   slots of finished rows (eos or budget) immediately, so the next boundary
-   can admit again.
+   slots of finished rows (eos or budget) immediately — pages and
+   reservations included — so the next boundary can admit again.
 
 Token parity contract: a request served here emits EXACTLY the tokens of an
 independent `generate(params, padded_prompt, cfg, gen,
@@ -50,6 +57,7 @@ import numpy as np
 from llama_pipeline_parallel_tpu.models.llama import decode
 from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
 from llama_pipeline_parallel_tpu.models.llama.decode import GenerationConfig
+from llama_pipeline_parallel_tpu.serve.pages import PagedKVCache
 from llama_pipeline_parallel_tpu.serve.slots import SlotKVCache
 from llama_pipeline_parallel_tpu.serve.telemetry import SLOStats
 from llama_pipeline_parallel_tpu.utils import trace
@@ -61,7 +69,21 @@ _REQUEST_IDS = itertools.count()
 
 
 class ServeOverloaded(RuntimeError):
-    """Wait queue full: the backpressure signal (HTTP 429 upstream)."""
+    """Wait queue full: the backpressure signal (HTTP 429 upstream).
+    `retry_after_s` is a coarse retry hint the frontend forwards as the
+    Retry-After header."""
+
+    retry_after_s: float = 1.0
+
+
+class ServePagesExhausted(ServeOverloaded):
+    """The free-page pool cannot cover this request's worst-case page
+    demand on top of everything already promised: refuse NOW (HTTP 429 +
+    Retry-After) instead of admitting and failing mid-decode."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class EngineShutdown(RuntimeError):
@@ -89,6 +111,15 @@ class ServeConfig:
     # replica; durations still accumulate exactly (the RunClock listener
     # sees the aggregate), only the file granularity coarsens
     decode_span_every: int = 32
+    # -- paged KV cache (docs/SERVING.md "Paged KV cache") -----------------
+    kv_cache: str = "dense"            # "dense" | "paged"
+    page_size: int = 64                # tokens per KV page (paged only)
+    num_pages: int | None = None       # pool size; None = dense-equivalent
+    kv_quant: str = "fp"               # "fp" | "int8" pages (paged only)
+    # per-tick prefill token budget AND chunk granularity (paged only):
+    # 0 = whole-prompt admissions; > 0 = a bucket larger than this prefills
+    # in pieces of exactly this many tokens, interleaved with decode ticks
+    prefill_chunk_tokens: int = 0
 
     def __post_init__(self) -> None:
         if self.decode_span_every < 1:
@@ -106,6 +137,55 @@ class ServeConfig:
                 f"bucket {min(self.prompt_buckets)} plus one generated token")
         if self.max_queue < 1:
             raise ValueError("max_queue must be >= 1")
+        if self.kv_cache not in ("dense", "paged"):
+            raise ValueError(f"kv_cache must be 'dense' or 'paged', got "
+                             f"{self.kv_cache!r}")
+        if self.kv_cache == "dense":
+            if self.kv_quant != "fp":
+                raise ValueError("kv_quant requires kv_cache: paged")
+            if self.prefill_chunk_tokens:
+                raise ValueError("prefill_chunk_tokens requires "
+                                 "kv_cache: paged")
+            return
+        if self.kv_quant not in ("fp", "int8"):
+            raise ValueError(f"kv_quant must be 'fp' or 'int8', got "
+                             f"{self.kv_quant!r}")
+        if self.page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        if self.max_len % self.page_size:
+            raise ValueError(f"max_len {self.max_len} must be a multiple of "
+                             f"page_size {self.page_size}")
+        for b in self.prompt_buckets:
+            if b % self.page_size:
+                raise ValueError(f"prompt bucket {b} must be a multiple of "
+                                 f"page_size {self.page_size} (page-aligned "
+                                 f"prefill writes)")
+        if self.prefill_chunk_tokens:
+            if self.prefill_chunk_tokens % self.page_size:
+                raise ValueError(
+                    f"prefill_chunk_tokens {self.prefill_chunk_tokens} must "
+                    f"be a multiple of page_size {self.page_size}")
+            for b in self.prompt_buckets:
+                if b > self.prefill_chunk_tokens and \
+                        b % self.prefill_chunk_tokens:
+                    raise ValueError(
+                        f"bucket {b} must be a multiple of "
+                        f"prefill_chunk_tokens {self.prefill_chunk_tokens} "
+                        f"(static chunk shapes)")
+        if self.num_pages is not None and \
+                self.num_pages < self.max_len // self.page_size:
+            raise ValueError(
+                f"num_pages {self.num_pages} cannot hold even one "
+                f"full-length request "
+                f"({self.max_len // self.page_size} pages)")
+
+    @property
+    def resolved_num_pages(self) -> int:
+        """The pool size: as configured, or the dense-equivalent capacity
+        (same logical tokens as the `[max_slots, max_len]` reservation)."""
+        if self.num_pages is not None:
+            return self.num_pages
+        return self.max_slots * self.max_len // self.page_size
 
 
 @dataclasses.dataclass
@@ -186,6 +266,23 @@ class _Running:
     t_first: float
 
 
+@dataclasses.dataclass
+class _Prefilling:
+    """Host-side state of a slot whose prompt is still prefilling (paged
+    chunked admissions; at most one request is mid-prefill at a time —
+    FIFO order makes a second partial pointless)."""
+
+    request: ServeRequest
+    handle: RequestHandle
+    slot: int
+    bucket: int
+    ids: np.ndarray          # [1, bucket] left-padded prompt
+    mask: np.ndarray         # [1, bucket]
+    positions: np.ndarray    # [1, bucket] rope positions
+    done: int                # prompt tokens prefilled so far
+    t_admit: float
+
+
 class ServeEngine:
     def __init__(self, params: dict, cfg: LlamaConfig, serve_cfg: ServeConfig,
                  metrics_writer=None):
@@ -195,16 +292,28 @@ class ServeEngine:
         self.params = params
         self.cfg = cfg
         self.serve_cfg = serve_cfg
-        self.slots = SlotKVCache(cfg, serve_cfg.max_slots, serve_cfg.max_len)
+        self._paged = serve_cfg.kv_cache == "paged"
+        if self._paged:
+            self.slots = PagedKVCache(
+                cfg, serve_cfg.max_slots, serve_cfg.max_len,
+                serve_cfg.page_size, serve_cfg.resolved_num_pages,
+                serve_cfg.kv_quant)
+        else:
+            self.slots = SlotKVCache(cfg, serve_cfg.max_slots,
+                                     serve_cfg.max_len)
         self.stats = SLOStats()
         self._metrics_writer = metrics_writer
         self._occupants: dict[int, _Running] = {}
+        self._prefilling: deque = deque()   # paged chunked admissions
         self._queue: deque = deque()
         self._closed = False
         self._lock = threading.Lock()
         self._work = threading.Event()   # ServeLoop parks on this when idle
         self._sample_first = jax.jit(decode.sample_rowwise)
         self.steps = 0
+        self.prefill_chunks_last_tick = 0
+        self.prefill_chunks_total = 0
+        self.prefill_tokens_total = 0
         # pending aggregated serve_decode_step span (decode_span_every)
         self._tick_ts = 0.0
         self._tick_accum = 0.0
@@ -235,11 +344,20 @@ class ServeEngine:
         queue full — shed load upstream). Both count as rejections in the
         SLO stats — an operator watching `requests_rejected` must see a
         storm of unservable shapes as clearly as queue overload."""
+        demand = 0
         try:
             if len(request.input_ids) == 0:
                 raise RequestRejected("empty prompt")
-            self.pick_bucket(len(request.input_ids),
-                             request.gen.max_new_tokens)
+            bucket = self.pick_bucket(len(request.input_ids),
+                                      request.gen.max_new_tokens)
+            if self._paged:
+                demand = self.slots.demand_pages(
+                    bucket, request.gen.max_new_tokens)
+                if demand > self.slots.num_pages:
+                    raise RequestRejected(
+                        f"worst-case demand of {demand} pages exceeds the "
+                        f"pool ({self.slots.num_pages} pages of "
+                        f"{self.slots.page_size} tokens)")
         except RequestRejected:
             self.stats.record_rejected()
             raise
@@ -251,17 +369,32 @@ class ServeEngine:
                 self.stats.record_rejected()
                 raise ServeOverloaded(
                     f"wait queue full ({self.serve_cfg.max_queue})")
-            self._queue.append((request, handle))
+            if demand and not self.slots.reserve(demand):
+                # refuse NOW: admitting would strand the request mid-decode
+                # when the pool runs dry under it
+                self.stats.record_rejected()
+                self.stats.record_page_refused()
+                raise ServePagesExhausted(
+                    f"free-page pool cannot cover the worst-case demand of "
+                    f"{demand} pages ({self.slots.pages_free} free, "
+                    f"{self.slots.pages_reserved}/{self.slots.num_pages} "
+                    f"reserved) — retry after a request completes")
+            self._queue.append((request, handle, demand))
         self._work.set()
         return handle
 
     # -- scheduling (the loop thread) -------------------------------------
 
     def step(self) -> bool:
-        """One step boundary: admit, then one decode tick over all slots.
-        Returns False when there was nothing to do (caller may sleep)."""
-        self._admit_pending()
+        """One step boundary: admit (dense, and paged without a chunk
+        budget: whole prompts) or advance bounded prefill chunks (paged
+        with one), then one decode tick over all slots. Returns False when
+        there was nothing to do (caller may sleep)."""
+        self._advance_prefill()
         if not self._occupants:
+            if self._prefilling:      # prefill-only tick is still work
+                self.steps += 1
+                return True
             self._flush_decode_span()  # idle boundary: publish the tail
             self._work.clear()
             # submit() may have raced the clear — don't sleep on a full queue
@@ -272,66 +405,159 @@ class ServeEngine:
         self.steps += 1
         return True
 
-    def _admit_pending(self) -> None:
+    # -- admission: the ONE prefill path for both caches -------------------
+
+    def _advance_prefill(self) -> None:
+        """Spend at most `prefill_chunk_tokens` prompt tokens on prefill
+        work this tick (unbounded when 0 — the dense cache and chunkless
+        paged configs admit whole prompts): continue the in-progress
+        chunked prefill first, then admit queued requests into free slots.
+        A bucket no larger than the chunk budget prefills in ONE shot (the
+        `prefill_prompt` + splice path — identical arithmetic on either
+        cache); a larger bucket (paged only) runs in chunk-sized pieces
+        across ticks, so in-flight decodes keep producing a token every
+        tick — no full-prefill stall."""
+        chunk = self.serve_cfg.prefill_chunk_tokens
+        spent = 0
+        chunks_run = 0
         while True:
-            with self._lock:
-                if not self._queue:
-                    return
-                slot = self.slots.acquire(self._queue[0][0].request_id)
-                if slot is None:
-                    return
-                request, handle = self._queue.popleft()
+            pf = self._prefilling[0] if self._prefilling else None
+            if pf is None:
+                entry = self._pop_admittable()
+                if entry is None:
+                    break
+                pf = self._start_prefill(*entry)
+                if pf is None:     # start failed; its handle already failed
+                    continue
+                self._prefilling.append(pf)
+            cost = pf.bucket if not chunk or pf.bucket <= chunk else chunk
+            if chunk and spent + cost > chunk:
+                break              # budget for this tick is spent
             try:
-                self._admit(request, handle, slot)
-            except Exception as e:  # a poisoned request must not kill serving
-                logger.exception("admission of %s failed", request.request_id)
-                self.stats.record_failed()  # visible on the metrics line
-                self.slots.release(slot)
-                handle._finish(e)
+                finished = self._run_prefill_chunk(pf, cost)
+            except Exception as e:
+                logger.exception("prefill of %s failed",
+                                 pf.request.request_id)
+                self.stats.record_failed()
+                self._prefilling.remove(pf)
+                self.slots.release(pf.slot)
+                pf.handle._finish(e)
+                continue
+            spent += cost
+            chunks_run += 1
+            if finished:
+                self._prefilling.remove(pf)
+        self.prefill_chunks_last_tick = chunks_run
+        if chunks_run:
+            self.prefill_chunks_total += chunks_run
+            self.prefill_tokens_total += spent
 
-    def _admit(self, request: ServeRequest, handle: RequestHandle,
-               slot: int) -> None:
-        gen = request.gen
-        t_admit = time.time()
-        trace.recorder().emit("serve_queue_wait", ts=request.arrival,
-                              dur=t_admit - request.arrival,
-                              request=request.request_id)
-        bucket = self.pick_bucket(len(request.input_ids), gen.max_new_tokens)
-        pad = bucket - len(request.input_ids)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, pad:] = np.asarray(request.input_ids, np.int32)
-        mask = np.zeros((1, bucket), np.int32)
-        mask[0, pad:] = 1
+    def _pop_admittable(self):
+        with self._lock:
+            if not self._queue:
+                return None
+            request, handle, demand = self._queue[0]
+            slot = self.slots.acquire(request.request_id, demand)
+            if slot is None:
+                return None
+            self._queue.popleft()
+        return request, handle, slot
 
-        with trace.span("serve_prefill", request=request.request_id,
-                        bucket=bucket, slot=slot):
-            out = decode.prefill_prompt(self.params, jnp.asarray(ids),
-                                        jnp.asarray(mask), self.cfg,
-                                        self.serve_cfg.max_len)
-            chain, first_key = jax.random.split(jax.random.PRNGKey(request.seed))
+    def _start_prefill(self, request: ServeRequest, handle: RequestHandle,
+                       slot: int) -> "_Prefilling | None":
+        try:
+            gen = request.gen
+            t_admit = time.time()
+            trace.recorder().emit("serve_queue_wait", ts=request.arrival,
+                                  dur=t_admit - request.arrival,
+                                  request=request.request_id)
+            bucket = self.pick_bucket(len(request.input_ids),
+                                      gen.max_new_tokens)
+            pad = bucket - len(request.input_ids)
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, pad:] = np.asarray(request.input_ids, np.int32)
+            mask = np.zeros((1, bucket), np.int32)
+            mask[0, pad:] = 1
+            positions = np.clip(np.cumsum(mask, axis=1) - 1, 0,
+                                None).astype(np.int32)
+            chunk = self.serve_cfg.prefill_chunk_tokens
+            if self._paged and chunk and bucket > chunk:
+                # incremental writes: the previous occupant's mask must die
+                self.slots.reset_mask_row(slot)
+            return _Prefilling(request=request, handle=handle, slot=slot,
+                               bucket=bucket, ids=ids, mask=mask,
+                               positions=positions, done=0, t_admit=t_admit)
+        except Exception as e:
+            logger.exception("admission of %s failed", request.request_id)
+            self.stats.record_failed()
+            self.slots.release(slot)
+            handle._finish(e)
+            return None
+
+    def _run_prefill_chunk(self, pf: _Prefilling, cost: int) -> bool:
+        """Run one prefill unit of `cost` tokens for `pf`; on the final
+        chunk, sample the request's first token (the same `sample_rowwise`
+        program and rng discipline as the dense admission) and join the
+        decode batch. Returns True when the request finished prefilling."""
+        slot = pf.slot
+        with trace.span("serve_prefill", request=pf.request.request_id,
+                        bucket=pf.bucket, slot=slot, chunk=cost,
+                        offset=pf.done):
+            if cost == pf.bucket:
+                # single shot; the prefill logits depend only on the prompt
+                # block, so the row capacity (dense: the whole max_len row
+                # write_slot splices; paged: the bucket write_pages pages)
+                # changes residency, never arithmetic
+                row_len = pf.bucket if self._paged else self.serve_cfg.max_len
+                out = decode.prefill_prompt(
+                    self.params, jnp.asarray(pf.ids), jnp.asarray(pf.mask),
+                    self.cfg, row_len)
+                self.slots.admit(slot, out)
+                logits = out["logits"]
+                next_pos = int(out["next_pos"][0])
+                pf.done = pf.bucket
+            else:
+                c0, c1 = pf.done, pf.done + cost
+                self.slots.ensure_capacity(slot, c1)
+                out = decode.paged_prefill_chunk(
+                    self.params, jnp.asarray(pf.ids[:, c0:c1]),
+                    jnp.asarray(pf.mask[:, c0:c1]),
+                    jnp.asarray(pf.positions[:, c0:c1]), self.slots.pool,
+                    jnp.asarray(self.slots.page_table[slot]),
+                    jnp.int32(slot), self.slots.kv_mask, jnp.int32(c0),
+                    self.cfg)
+                self.slots.pool = out["pool"]
+                self.slots.kv_mask = out["kv_mask"]
+                logits = out["logits"]
+                next_pos = int(pf.positions[0, -1]) + 1
+                pf.done = c1
+            if pf.done < pf.bucket:
+                return False
+            gen = pf.request.gen
+            chain, first_key = jax.random.split(
+                jax.random.PRNGKey(pf.request.seed))
             first = self._sample_first(
-                out["logits"],
+                logits,
                 jnp.asarray([gen.temperature], jnp.float32),
                 jnp.asarray([gen.top_k], jnp.int32),
                 jnp.asarray([gen.top_p], jnp.float32),
                 first_key[None])
-            self.slots.admit(slot, out)
             token = int(first[0])
-            next_pos = int(out["next_pos"][0])
 
         t_first = time.time()
-        trace.recorder().emit("serve_ttft", ts=request.arrival,
-                              dur=t_first - request.arrival,
-                              request=request.request_id)
-        running = _Running(request=request, handle=handle, token=token,
-                           pos=next_pos, write_pos=bucket,
+        trace.recorder().emit("serve_ttft", ts=pf.request.arrival,
+                              dur=t_first - pf.request.arrival,
+                              request=pf.request.request_id)
+        running = _Running(request=pf.request, handle=pf.handle, token=token,
+                           pos=next_pos, write_pos=pf.bucket,
                            key=np.asarray(chain), emitted=1,
-                           t_admit=t_admit, t_first=t_first)
+                           t_admit=pf.t_admit, t_first=t_first)
         self._occupants[slot] = running
-        handle._push(token)
+        pf.handle._push(token)
         if (gen.eos_token_id is not None and token == gen.eos_token_id) \
                 or gen.max_new_tokens == 1:
             self._finish(slot, running)  # freed before any decode tick
+        return True
 
     def _decode_tick(self) -> None:
         scfg = self.serve_cfg
@@ -355,11 +581,28 @@ class ServeEngine:
         n_active = len(self._occupants)
         t_wall = time.time()
         t0 = time.perf_counter()
-        out = decode.decode_step(
-            self.params, jnp.asarray(token), self.slots.cache,
-            jnp.asarray(pos), jnp.asarray(write_pos), self.slots.kv_mask,
-            jnp.asarray(keys), jnp.asarray(temps), jnp.asarray(top_ks),
-            jnp.asarray(top_ps), self.cfg)
+        if self._paged:
+            # back the next write of every active row BEFORE the tick: the
+            # submit-time reservation guarantees these allocations succeed
+            for slot, r in self._occupants.items():
+                self.slots.ensure_capacity(slot, r.write_pos + 1)
+            # only occupant rows may write/mark kv: a mid-prefill slot
+            # already owns live pages and mask spans this tick must not touch
+            active = np.zeros(scfg.max_slots, np.int32)
+            for slot in self._occupants:
+                active[slot] = 1
+            out = decode.paged_decode_step(
+                self.params, jnp.asarray(token), self.slots.pool,
+                jnp.asarray(self.slots.page_table), jnp.asarray(pos),
+                jnp.asarray(write_pos), self.slots.kv_mask,
+                jnp.asarray(active), jnp.asarray(keys), jnp.asarray(temps),
+                jnp.asarray(top_ks), jnp.asarray(top_ps), self.cfg)
+        else:
+            out = decode.decode_step(
+                self.params, jnp.asarray(token), self.slots.cache,
+                jnp.asarray(pos), jnp.asarray(write_pos), self.slots.kv_mask,
+                jnp.asarray(keys), jnp.asarray(temps), jnp.asarray(top_ks),
+                jnp.asarray(top_ps), self.cfg)
         self.slots.update_from_step(out)
         next_token = np.asarray(out["token"])       # blocks: real tick time
         new_keys = np.asarray(out["keys"])
@@ -434,12 +677,26 @@ class ServeEngine:
         snap["queue_depth"] = self.queue_depth()
         snap["slot_allocations"] = self.slots.allocations
         snap["decode_steps"] = self.steps
+        if self._paged:
+            scfg = self.serve_cfg
+            snap["kv_cache"] = "paged"
+            snap["kv_quant"] = scfg.kv_quant
+            snap["page_size"] = scfg.page_size
+            snap["pages_total"] = self.slots.num_pages
+            snap["pages_used"] = self.slots.pages_used
+            snap["pages_free"] = self.slots.pages_free
+            snap["pages_reserved"] = self.slots.pages_reserved
+            snap["page_allocations"] = self.slots.page_allocations
+            snap["prefilling"] = len(self._prefilling)
+            snap["prefill_chunks_last_tick"] = self.prefill_chunks_last_tick
+            snap["prefill_chunks_total"] = self.prefill_chunks_total
+            snap["prefill_tokens_total"] = self.prefill_tokens_total
         return snap
 
     def drain(self, timeout_s: float = 60.0) -> None:
         """Step until queue and slots are empty (tests / synchronous use)."""
         deadline = time.monotonic() + timeout_s
-        while self._occupants or self.queue_depth():
+        while self._occupants or self._prefilling or self.queue_depth():
             if time.monotonic() > deadline:
                 raise TimeoutError("engine did not drain in time")
             self.step()
@@ -454,8 +711,14 @@ class ServeEngine:
             self._closed = True
             pending = list(self._queue)
             self._queue.clear()
-        for _, handle in pending:
+        for _, handle, demand in pending:
+            if demand:
+                self.slots.unreserve(demand)
             handle._finish(err)
+        while self._prefilling:
+            pf = self._prefilling.popleft()
+            self.slots.release(pf.slot)
+            pf.handle._finish(err)
         for slot in list(self._occupants):
             r = self._occupants.pop(slot)
             self.slots.release(slot)

@@ -16,10 +16,12 @@ maps it onto sockets:
   GET /healthz        engine SLO/occupancy snapshot (the same dict the
                       serving metrics line carries).
 
-Backpressure maps to status codes: ServeOverloaded -> 429 (wait queue
-full), RequestRejected -> 400 (shape can never be served). The engine loop
-runs elsewhere (tools/serve.py main thread or ServeLoop); handler threads
-only block on their request's handle.
+Backpressure maps to status codes: ServeOverloaded -> 429 with a
+Retry-After header (wait queue full, or — its ServePagesExhausted
+subclass — the paged cache's free-page pool cannot cover the request's
+worst-case demand), RequestRejected -> 400 (shape can never be served).
+The engine loop runs elsewhere (tools/serve.py main thread or ServeLoop);
+handler threads only block on their request's handle.
 """
 
 from __future__ import annotations
@@ -69,11 +71,14 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # stdlib default spams stderr
         logger.debug("http %s", fmt % args)
 
-    def _send_json(self, code: int, payload: dict) -> None:
+    def _send_json(self, code: int, payload: dict,
+                   headers: dict | None = None) -> None:
         body = (json.dumps(payload) + "\n").encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
@@ -94,7 +99,12 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             handle = self.engine.submit(request)
         except ServeOverloaded as e:
-            return self._send_json(429, {"error": str(e)})
+            # 429 + Retry-After: queue overload AND page-pool exhaustion
+            # (ServePagesExhausted) both tell the client to back off and
+            # come back — the hint is coarse, not a promise
+            retry = max(1, int(-(-getattr(e, "retry_after_s", 1.0) // 1)))
+            return self._send_json(429, {"error": str(e)},
+                                   headers={"Retry-After": str(retry)})
         except RequestRejected as e:
             return self._send_json(400, {"error": str(e)})
         except EngineShutdown as e:  # process exiting: go to another replica

@@ -1,0 +1,234 @@
+"""Paged KV cache: fixed-size pages + a slot->page table (docs/SERVING.md).
+
+`SlotKVCache` reserves `[max_slots, max_len]` up front — every slot is
+charged one worst-case request whether it holds three tokens or three
+thousand. This manager backs the same logical rows with PAGES from a shared
+pool (`decode.init_page_pool`), so resident HBM tracks tokens actually
+written:
+
+- a request's **worst-case page demand** (`page_demand`) is reserved at
+  submit time — admission control, the backpressure signal the frontend
+  maps to HTTP 429 + Retry-After — but physical pages are allocated
+  LAZILY: prompt pages at admission, decode pages as `write_pos` crosses
+  each page boundary (`ensure_capacity`). Reservation <= pool is the
+  invariant that makes mid-decode allocation infallible: a request that
+  was admitted can always finish.
+- `release` returns the slot's pages to the free pool, resets its
+  page-table row to the GARBAGE page (index `num_pages` — the extra page
+  every inactive slot scatters into while riding the static-shape decode
+  step), and returns its reservation.
+- the device state is the pool + the logical `[max_slots, max_len]`
+  kv_mask; the page table itself stays HOST-side (numpy) and is shipped as
+  a small int32 array each tick — page residency changes never recompile
+  anything.
+
+The interface mirrors `SlotKVCache` (acquire/admit/release/active_count/
+assignments/allocations) so `ServeEngine` and tools/serve.py treat either
+cache uniformly; the paged extras (reserve/ensure_capacity/page gauges)
+only the paged scheduler touches.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from llama_pipeline_parallel_tpu.models.llama import decode
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+
+
+def page_demand(bucket: int, max_new_tokens: int, page_size: int) -> int:
+    """Worst-case pages a request can ever touch: the prompt bucket plus
+    the decode writes (the budget's last token is emitted without a cache
+    write, so `max_new_tokens - 1` of them; a 1-token request writes only
+    its prompt)."""
+    positions = bucket + max(max_new_tokens - 1, 0)
+    return -(-positions // page_size)
+
+
+def dense_kv_cache_bytes(cfg: LlamaConfig, max_slots: int,
+                         max_len: int) -> int:
+    """Resident bytes of the dense `SlotKVCache` reservation."""
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    return (2 * cfg.num_hidden_layers * max_slots * max_len * cfg.kv_heads
+            * cfg.head_dim * itemsize)
+
+
+def paged_pool_bytes(cfg: LlamaConfig, num_pages: int, page_size: int,
+                     quant: str = "fp") -> int:
+    """Resident bytes of a page pool (garbage page and int8 scales
+    included — the capacity comparison must not hide overheads)."""
+    itemsize = 1 if quant == "int8" else jnp.dtype(cfg.dtype).itemsize
+    kv = (2 * cfg.num_hidden_layers * (num_pages + 1) * page_size
+          * cfg.kv_heads * cfg.head_dim * itemsize)
+    if quant == "int8":
+        kv += 2 * cfg.num_hidden_layers * (num_pages + 1) * cfg.kv_heads * 4
+    return kv
+
+
+class PagedKVCache:
+    def __init__(self, cfg: LlamaConfig, max_slots: int, max_len: int,
+                 page_size: int, num_pages: int, quant: str = "fp"):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if max_len % page_size:
+            raise ValueError(f"max_len {max_len} must be a multiple of "
+                             f"page_size {page_size}")
+        if num_pages < max_len // page_size:
+            raise ValueError(
+                f"num_pages {num_pages} cannot hold even one full-length "
+                f"request ({max_len // page_size} pages)")
+        if quant not in ("fp", "int8"):
+            raise ValueError(f"quant must be 'fp' or 'int8', got {quant!r}")
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.max_len = max_len
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.quant = quant
+        self.pages_per_slot = max_len // page_size
+        self.garbage_page = num_pages
+
+        self.pool = decode.init_page_pool(cfg, num_pages, page_size, quant)
+        self.kv_mask = jnp.zeros((max_slots, max_len), jnp.int32)
+        self.page_table = np.full((max_slots, self.pages_per_slot),
+                                  self.garbage_page, np.int32)
+
+        self._lock = threading.Lock()
+        self._free_slots = list(range(max_slots - 1, -1, -1))  # pop -> lowest
+        self._free_pages = list(range(num_pages - 1, -1, -1))
+        self._owned: dict[int, list[int]] = {}
+        self._slot_reserved: dict[int, int] = {}
+        self._slot_reserved_total = 0  # sum of _slot_reserved (int reads are
+        self._queued_reserved = 0      # race-safe for lock-free gauges;
+        # pages promised to still-queued requests — iterating the dict from
+        # another thread would not be)
+        self.assignments: list[tuple[int, str]] = []
+        self.allocations = 1          # the pool is allocated ONCE
+        self.page_allocations = 0     # cumulative page hand-outs (reuse proof)
+
+    # -- gauges ------------------------------------------------------------
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free_slots)
+
+    @property
+    def active_count(self) -> int:
+        return self.max_slots - len(self._free_slots)
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free_pages)
+
+    @property
+    def pages_used(self) -> int:
+        return self.num_pages - len(self._free_pages)
+
+    @property
+    def pages_reserved(self) -> int:
+        return self._queued_reserved + self._slot_reserved_total
+
+    def demand_pages(self, bucket: int, max_new_tokens: int) -> int:
+        return page_demand(bucket, max_new_tokens, self.page_size)
+
+    # -- reservation (admission control; any thread) -----------------------
+
+    def reserve(self, n: int) -> bool:
+        """Commit `n` pages to a not-yet-admitted request; False when the
+        pool cannot cover it on top of everything already promised — the
+        refusal signal, instead of admitting and failing mid-decode."""
+        with self._lock:
+            if self.pages_reserved + n > self.num_pages:
+                return False
+            self._queued_reserved += n
+            return True
+
+    def unreserve(self, n: int) -> None:
+        with self._lock:
+            if n > self._queued_reserved:
+                raise ValueError(f"unreserve({n}) exceeds queued reservation "
+                                 f"{self._queued_reserved}")
+            self._queued_reserved -= n
+
+    # -- lifecycle (the engine loop thread) --------------------------------
+
+    def acquire(self, request_id: str, reserved_pages: int) -> int | None:
+        """A free slot carrying the request's page reservation (moved from
+        the queued pot), or None when every slot is occupied."""
+        with self._lock:
+            if not self._free_slots:
+                return None
+            slot = self._free_slots.pop()
+            self._queued_reserved -= reserved_pages
+            self._slot_reserved[slot] = reserved_pages
+            self._slot_reserved_total += reserved_pages
+            self._owned[slot] = []
+            self.assignments.append((slot, request_id))
+            return slot
+
+    def ensure_capacity(self, slot: int, tokens: int) -> int:
+        """Allocate physical pages until logical positions [0, tokens) are
+        backed; returns how many pages were newly allocated. Infallible for
+        admitted requests (`tokens` within the reservation); anything past
+        it is a scheduler bug and raises."""
+        need = -(-tokens // self.page_size)
+        with self._lock:
+            owned = self._owned[slot]
+            if need > self._slot_reserved[slot]:
+                raise RuntimeError(
+                    f"slot {slot} needs {need} pages but reserved only "
+                    f"{self._slot_reserved[slot]} — page accounting bug")
+            grew = 0
+            while len(owned) < need:
+                page = self._free_pages.pop()  # cannot fail: reserved <= pool
+                self.page_table[slot, len(owned)] = page
+                owned.append(page)
+                self.page_allocations += 1
+                grew += 1
+            return grew
+
+    def release(self, slot: int) -> None:
+        with self._lock:
+            if slot in self._free_slots or not 0 <= slot < self.max_slots:
+                raise ValueError(f"release of slot {slot} not currently held")
+            self._free_pages.extend(self._owned.pop(slot, ()))
+            self._free_pages.sort(reverse=True)   # keep lowest-first reuse
+            self.page_table[slot, :] = self.garbage_page
+            self._slot_reserved_total -= self._slot_reserved.pop(slot, 0)
+            self._free_slots.append(slot)
+            self._free_slots.sort(reverse=True)
+
+    # -- device-state plumbing --------------------------------------------
+
+    def admit(self, slot: int, prefill_out: dict) -> None:
+        """Splice a bucket-sized `prefill_prompt` result (b == 1, max_len ==
+        bucket) into the slot's pages — the single-shot (bit-exact) path."""
+        bucket = prefill_out["kv_mask"].shape[1]
+        self.ensure_capacity(slot, bucket)
+        n = bucket // self.page_size
+        self.pool, self.kv_mask = decode.write_pages(
+            self.pool, self.kv_mask, jnp.int32(slot),
+            jnp.asarray(self.page_table[slot, :n]),
+            prefill_out["cache"], prefill_out["kv_mask"])
+
+    def reset_mask_row(self, slot: int) -> None:
+        """Kill the previous occupant's logical mask before a CHUNKED
+        prefill starts writing the row incrementally."""
+        self.kv_mask = decode.reset_kv_mask_row(self.kv_mask, jnp.int32(slot))
+
+    def update_from_step(self, step_out: dict) -> None:
+        """Adopt the pool/kv_mask a `paged_decode_step` returned (inputs
+        were donated — the old buffers are gone)."""
+        self.pool = step_out["pool"]
+        self.kv_mask = step_out["kv_mask"]
+
+    def reused_slot_count(self) -> int:
+        seen: dict[int, int] = {}
+        for slot, _ in self.assignments:
+            seen[slot] = seen.get(slot, 0) + 1
+        return sum(1 for n in seen.values() if n > 1)

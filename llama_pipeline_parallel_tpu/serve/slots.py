@@ -19,6 +19,13 @@ batch axis as SLOTS:
 `assignments` keeps a (slot, request_id) history and `allocations` counts
 cache allocations (it stays 1 for the life of the engine) — the slot-reuse
 proof the serving e2e test pins.
+
+The per-slot worst-case reservation is the dense cache's capacity ceiling:
+every slot is charged `max_len` whether it holds 3 tokens or 3000. The
+paged alternative (`serve/pages.py`, `ServeConfig(kv_cache="paged")`)
+keeps this module's interface but backs the rows with fixed-size pages so
+HBM tracks tokens actually generated; this dense manager remains the
+default and the bit-parity reference.
 """
 
 from __future__ import annotations
@@ -55,8 +62,11 @@ class SlotKVCache:
     def active_count(self) -> int:
         return self.max_slots - len(self._free)
 
-    def acquire(self, request_id: str) -> int | None:
-        """A free slot index, or None when every row is occupied."""
+    def acquire(self, request_id: str, reserved_pages: int = 0) -> int | None:
+        """A free slot index, or None when every row is occupied.
+        `reserved_pages` is accepted (and ignored) so the engine's one
+        admission path treats both caches uniformly — the dense row IS the
+        reservation."""
         if not self._free:
             return None
         slot = self._free.pop()

@@ -65,6 +65,7 @@ class SLOStats:
         self.completed = 0
         self.rejected = 0
         self.failed = 0
+        self.page_refused = 0
         self.tokens_generated = 0
 
     def record(self, ttft: float, tpot: float | None, queue_wait: float,
@@ -88,6 +89,14 @@ class SLOStats:
         with self._lock:
             self.failed += 1
 
+    def record_page_refused(self) -> None:
+        """Rejected because the free-page pool could not cover the
+        request's worst-case demand (paged cache only; counted within
+        `requests_rejected` too — this breaks out the capacity signal
+        an operator scales replicas on)."""
+        with self._lock:
+            self.page_refused += 1
+
     def snapshot(self) -> dict:
         """One flat dict: cumulative counters + windowed percentiles, ms."""
         with self._lock:
@@ -95,6 +104,7 @@ class SLOStats:
                 "requests_completed": self.completed,
                 "requests_rejected": self.rejected,
                 "requests_failed": self.failed,
+                "requests_page_refused": self.page_refused,
                 "tokens_generated": self.tokens_generated,
             }
             out.update(percentiles_ms(list(self.ttft), "ttft"))

@@ -1,0 +1,592 @@
+"""Paged KV cache + chunked batched prefill (serve/pages.py, the paged
+entry points in models/llama/decode.py, and the engine's paged scheduler —
+docs/SERVING.md "Paged KV cache").
+
+The acceptance contracts live here:
+- fp paged decode is TOKEN-BIT-EXACT vs the dense `SlotKVCache` path on
+  the serving parity grid (staggered mixed-config requests, page-boundary
+  crossings, slot + page reuse), reusing the engine's existing parity
+  machinery (tokens == an independent generate() call per request).
+- chunked prefill admits a long-prompt request during active decode and
+  every in-flight stream keeps producing a token EVERY tick, bounded by
+  the per-tick chunk budget — no full-prefill stall.
+- admission refuses (ServePagesExhausted -> HTTP 429 + Retry-After) when
+  the free-page pool cannot cover a request's worst-case page demand, and
+  the SAME request succeeds after a release.
+- int8 pages pass a tolerance gate vs the dequantized fp reference, and
+  the paged cache admits >= 2x the dense cache's concurrent requests at
+  the same HBM budget (>= 4x with int8 pages).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llama_pipeline_parallel_tpu.models.llama import decode
+from llama_pipeline_parallel_tpu.models.llama import model as llama
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+from llama_pipeline_parallel_tpu.models.llama.decode import (
+    GenerationConfig,
+    generate,
+)
+from llama_pipeline_parallel_tpu.serve import (
+    PagedKVCache,
+    RequestRejected,
+    ServeConfig,
+    ServeEngine,
+    ServePagesExhausted,
+    ServeRequest,
+)
+from llama_pipeline_parallel_tpu.serve.pages import (
+    dense_kv_cache_bytes,
+    page_demand,
+    paged_pool_bytes,
+)
+
+BUCKET = 8
+PAGE = 4
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def make_engine(cfg, params, **kw):
+    """The standard paged test shape — shared across tests so the paged
+    decode/prefill programs compile once per pool dtype."""
+    defaults = dict(max_slots=2, max_len=BUCKET + 8, prompt_buckets=(BUCKET,),
+                    max_queue=8, metrics_every=1, decode_span_every=1,
+                    kv_cache="paged", page_size=PAGE, num_pages=16)
+    defaults.update(kw)
+    return ServeEngine(params, cfg, ServeConfig(**defaults))
+
+
+def reference_tokens(params, cfg, prompt, gen, seed, bucket=BUCKET):
+    pad = bucket - len(prompt)
+    ids = np.concatenate([np.zeros(pad, np.int32),
+                          np.asarray(prompt, np.int32)])[None]
+    mask = np.asarray([[0] * pad + [1] * len(prompt)], np.int32)
+    out = generate(params, jnp.asarray(ids), jnp.asarray(mask), cfg, gen,
+                   rng=jax.random.PRNGKey(seed))
+    return np.asarray(out["tokens"])[0].tolist()
+
+
+# -- page lifecycle (host bookkeeping) ----------------------------------------
+
+
+def test_page_demand_model():
+    # prompt pages only at max_new=1 (the budget's last token never writes)
+    assert page_demand(8, 1, 4) == 2
+    assert page_demand(8, 2, 4) == 3   # one decode write crosses into page 3
+    assert page_demand(8, 5, 4) == 3   # writes reach position 11: 3 pages
+    assert page_demand(8, 6, 4) == 4   # position 12 opens page 4
+
+
+def test_page_lifecycle_acquire_append_release_reuse():
+    cfg = LlamaConfig.tiny()
+    cache = PagedKVCache(cfg, max_slots=2, max_len=16, page_size=4,
+                         num_pages=6)
+    assert (cache.pages_free, cache.pages_reserved) == (6, 0)
+    assert cache.reserve(4) and cache.pages_reserved == 4
+    assert not cache.reserve(3)        # 4 + 3 > 6: refusal, not overcommit
+    assert cache.reserve(2)
+
+    slot = cache.acquire("r1", 4)
+    assert slot == 0 and cache.pages_reserved == 6  # moved, not doubled
+    # lazy allocation: pages appear as the write frontier crosses boundaries
+    assert cache.ensure_capacity(slot, 1) == 1
+    assert cache.ensure_capacity(slot, 4) == 0      # still page 1
+    assert cache.ensure_capacity(slot, 5) == 1      # crosses into page 2
+    assert cache.ensure_capacity(slot, 16) == 2     # the reservation's rest
+    assert cache.pages_used == 4 and cache.pages_free == 2
+    assert list(cache.page_table[slot]) == [0, 1, 2, 3]  # lowest-first
+    with pytest.raises(RuntimeError):   # past the reservation = scheduler bug
+        cache.ensure_capacity(slot, 17)
+
+    # release: pages evicted back to the pool, row points at garbage again
+    cache.release(slot)
+    assert cache.pages_free == 6 and cache.pages_reserved == 2
+    assert set(cache.page_table[slot]) == {cache.garbage_page}
+    with pytest.raises(ValueError):
+        cache.release(slot)             # double free
+
+    # reuse: the released pages are handed out again, lowest-first
+    slot2 = cache.acquire("r2", 2)      # consumes the earlier reserve(2)
+    assert slot2 == 0
+    cache.ensure_capacity(slot2, 8)
+    assert list(cache.page_table[slot2][:2]) == [0, 1]
+    assert cache.page_allocations == 6  # 4 + 2 cumulative hand-outs
+    assert cache.pages_reserved == 2    # all held by the slot now
+    with pytest.raises(ValueError):
+        cache.unreserve(1)              # nothing queued anymore
+    assert cache.reserve(4)             # released capacity reservable again
+    cache.unreserve(4)
+
+
+def test_paged_config_validation():
+    base = dict(max_slots=2, max_len=16, prompt_buckets=(8,),
+                kv_cache="paged", page_size=4)
+    assert ServeConfig(**base).resolved_num_pages == 8  # dense-equivalent
+    with pytest.raises(ValueError):
+        ServeConfig(**{**base, "max_len": 18})          # not page-aligned
+    with pytest.raises(ValueError):
+        ServeConfig(**{**base, "prompt_buckets": (6,)})  # bucket unaligned
+    with pytest.raises(ValueError):
+        ServeConfig(**{**base, "prefill_chunk_tokens": 6})  # chunk unaligned
+    with pytest.raises(ValueError):
+        # bucket 16 > chunk 12 but not a multiple: no static chunk shape
+        ServeConfig(max_slots=2, max_len=32, prompt_buckets=(16,),
+                    kv_cache="paged", page_size=4, prefill_chunk_tokens=12)
+    with pytest.raises(ValueError):
+        ServeConfig(**{**base, "num_pages": 3})         # < one full request
+    with pytest.raises(ValueError):
+        ServeConfig(**{**base, "kv_quant": "int4"})
+    with pytest.raises(ValueError):
+        ServeConfig(max_slots=2, max_len=16, prompt_buckets=(8,),
+                    kv_quant="int8")                    # paged-only knob
+    with pytest.raises(ValueError):
+        ServeConfig(max_slots=2, max_len=16, prompt_buckets=(8,),
+                    prefill_chunk_tokens=8)             # paged-only knob
+    with pytest.raises(ValueError):
+        ServeConfig(max_slots=2, max_len=16, prompt_buckets=(8,),
+                    kv_cache="rowed")
+
+
+# -- the fp parity grid: paged == dense == generate(), bit for bit -----------
+
+
+def test_paged_token_parity_vs_dense_and_generate(setup):
+    """Staggered mixed-config requests through 2 slots on BOTH caches:
+    every paged stream must equal the dense stream AND the independent
+    generate() call token-for-token (fp pages are a residency change, not
+    an arithmetic one), with decode writes crossing page boundaries and
+    pages recycled across requests."""
+    cfg, params = setup
+    rs = np.random.RandomState(0)
+    gens = [GenerationConfig(max_new_tokens=6),                       # greedy
+            GenerationConfig(max_new_tokens=4, temperature=0.8, top_k=5),
+            GenerationConfig(max_new_tokens=6, temperature=0.7, top_p=0.9),
+            GenerationConfig(max_new_tokens=5, temperature=1.1)]
+    prompts = [rs.randint(3, cfg.vocab_size, (n,)).tolist()
+               for n in (5, 8, 3, 7)]
+
+    streams = {}
+    for kind in ("dense", "paged"):
+        engine = (make_engine(cfg, params) if kind == "paged" else
+                  ServeEngine(params, cfg, ServeConfig(
+                      max_slots=2, max_len=BUCKET + 8,
+                      prompt_buckets=(BUCKET,), max_queue=8,
+                      metrics_every=1, decode_span_every=1)))
+        handles = [engine.submit(ServeRequest(input_ids=p, gen=g, seed=i))
+                   for i, (p, g) in enumerate(zip(prompts[:2], gens[:2]))]
+        engine.step()
+        engine.step()
+        handles += [engine.submit(ServeRequest(input_ids=p, gen=g,
+                                               seed=i + 2))
+                    for i, (p, g) in enumerate(zip(prompts[2:], gens[2:]))]
+        engine.drain(timeout_s=120)
+        streams[kind] = [h.result(timeout=1) for h in handles]
+        if kind == "paged":
+            # slot AND page reuse: one pool allocation, pages recycled
+            assert engine.slots.allocations == 1
+            assert engine.slots.reused_slot_count() >= 1
+            assert engine.slots.pages_free == engine.slots.num_pages
+            assert engine.slots.pages_reserved == 0
+            assert engine.slots.page_allocations > max(
+                engine.slots.demand_pages(BUCKET, g.max_new_tokens)
+                for g in gens)        # reuse, not one giant reservation
+            snap = engine.metrics_snapshot()
+            assert snap["kv_cache"] == "paged"
+            assert snap["pages_total"] == 16
+            assert snap["requests_completed"] == 4
+
+    assert streams["paged"] == streams["dense"], \
+        "paged fp decode diverged from the dense slot cache"
+    for i, (p, g) in enumerate(zip(prompts, gens)):
+        assert streams["paged"][i] == reference_tokens(params, cfg, p, g, i)
+
+
+def test_paged_token_parity_bit_exact_bf16(setup):
+    """The same bit-parity contract in the serving compute dtype: bf16
+    paged streams equal the bf16 dense streams and the bf16 generate()
+    reference token-for-token (greedy + sampled)."""
+    import jax.numpy as jnp16  # noqa: F401  (clarity: dtype-only variant)
+
+    cfg = LlamaConfig.tiny(dtype=jnp.bfloat16)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    rs = np.random.RandomState(4)
+    gens = [GenerationConfig(max_new_tokens=5),
+            GenerationConfig(max_new_tokens=4, temperature=0.9, top_k=6)]
+    prompts = [rs.randint(3, cfg.vocab_size, (n,)).tolist() for n in (5, 8)]
+
+    streams = {}
+    for kind in ("dense", "paged"):
+        kw = dict(max_slots=2, max_len=BUCKET + 8, prompt_buckets=(BUCKET,),
+                  max_queue=8, metrics_every=1, decode_span_every=1)
+        if kind == "paged":
+            kw.update(kv_cache="paged", page_size=PAGE, num_pages=16)
+        engine = ServeEngine(params, cfg, ServeConfig(**kw))
+        handles = [engine.submit(ServeRequest(input_ids=p, gen=g, seed=i))
+                   for i, (p, g) in enumerate(zip(prompts, gens))]
+        engine.drain(timeout_s=120)
+        streams[kind] = [h.result(timeout=1) for h in handles]
+    assert streams["paged"] == streams["dense"]
+    for i, (p, g) in enumerate(zip(prompts, gens)):
+        assert streams["paged"][i] == reference_tokens(params, cfg, p, g, i)
+
+
+def test_paged_eos_finishes_row_early_and_frees_pages(setup):
+    """eos frees the slot AND its pages before the budget (the paged
+    counterpart of the dense eos row, which it subsumes)."""
+    cfg, params = setup
+    engine = make_engine(cfg, params, max_slots=1)
+    prompt = np.random.RandomState(2).randint(3, cfg.vocab_size, (4,)).tolist()
+
+    free = engine.submit(ServeRequest(
+        input_ids=prompt, gen=GenerationConfig(max_new_tokens=8), seed=0))
+    engine.drain(timeout_s=60)
+    eos = free.result(timeout=1)[0]  # force eos on the very first token
+
+    gen = GenerationConfig(max_new_tokens=8, eos_token_id=eos, pad_token_id=17)
+    h = engine.submit(ServeRequest(input_ids=prompt, gen=gen, seed=0))
+    engine.drain(timeout_s=60)
+    assert h.result(timeout=1) == [eos]
+    assert engine.slots.free_count == 1
+    assert engine.slots.pages_free == engine.slots.num_pages
+    assert engine.slots.pages_reserved == 0
+    ref = reference_tokens(params, cfg, prompt, gen, 0)
+    assert ref[0] == eos and all(t == 17 for t in ref[1:])
+
+
+# -- chunked batched prefill: no full-prefill stall ---------------------------
+
+
+def chunked_engine(cfg, params, **kw):
+    """The chunked-prefill shape (shared with tests/test_serve_traffic.py
+    so the chunk/decode programs compile once): buckets 8 and 32, 8-token
+    per-tick budget — a bucket-32 prompt takes 4 interleaved chunks."""
+    defaults = dict(max_slots=2, max_len=48, prompt_buckets=(8, 32),
+                    page_size=4, kv_cache="paged", num_pages=24,
+                    prefill_chunk_tokens=8, max_queue=32, metrics_every=1,
+                    decode_span_every=1)
+    defaults.update(kw)
+    return ServeEngine(params, cfg, ServeConfig(**defaults))
+
+
+def test_chunked_prefill_no_stall_and_token_parity(setup):
+    """THE no-stall acceptance: a long-prompt admission during active
+    decode runs as bounded chunks — the in-flight stream gains exactly one
+    token EVERY tick of the prefill window — and the chunked request's
+    tokens still match its independent generate() reference (greedy and
+    sampled)."""
+    cfg, params = setup
+    engine = chunked_engine(cfg, params)
+    rs = np.random.RandomState(1)
+    short = rs.randint(3, cfg.vocab_size, (5,)).tolist()
+    long_p = rs.randint(3, cfg.vocab_size, (20,)).tolist()
+
+    ga = GenerationConfig(max_new_tokens=20)
+    a = engine.submit(ServeRequest(input_ids=short, gen=ga, seed=0))
+    engine.step()                      # bucket 8 <= chunk 8: one-shot admit
+    engine.step()
+    assert len(a.tokens_out) >= 2      # actively decoding
+
+    gb = GenerationConfig(max_new_tokens=6)
+    b = engine.submit(ServeRequest(input_ids=long_p, gen=gb, seed=7))
+    # bucket 32 / chunk 8 = 4 interleaved chunks; A must advance EVERY tick
+    for tick in range(4):
+        n_a = len(a.tokens_out)
+        engine.step()
+        assert len(a.tokens_out) == n_a + 1, \
+            f"in-flight stream stalled at prefill tick {tick}"
+        assert engine.prefill_chunks_last_tick == 1
+        if tick < 3:
+            assert len(b.tokens_out) == 0   # still prefilling
+            # the decode tick must not touch the mid-prefill row: B's
+            # position 0 is a LEFT PAD (20-token prompt in a 32 bucket)
+            # and must stay unmasked while its slot rides the tick
+            slot_b = engine._prefilling[0].slot
+            assert int(np.asarray(engine.slots.kv_mask)[slot_b, 0]) == 0, \
+                "decode tick polluted the mid-prefill slot's kv mask"
+    assert len(b.tokens_out) >= 1           # joined at its final chunk
+    snap = engine.metrics_snapshot()
+    assert snap["prefill_chunks_total"] >= 5  # A's one-shot + B's four
+    assert snap["prefill_tokens_total"] >= 8 + 32
+
+    # a SAMPLED request whose chunked prefill interleaves with A's still-
+    # running decode — the regression shape for the mid-prefill pollution
+    # bug (a tick writing garbage kv + a spurious mask bit into the
+    # prefilling row flipped exactly this temperature-0.9/seed-1 stream):
+    # B's slot frees after its 6 tokens while A (20-token budget) is still
+    # decoding, so D's 4 chunks run against live decode ticks
+    while not b.done:
+        engine.step()
+    assert not a.done                      # A still mid-decode
+    gd = GenerationConfig(max_new_tokens=6, temperature=0.9)
+    d = engine.submit(ServeRequest(input_ids=long_p, gen=gd, seed=1))
+    for _ in range(4):                     # D's whole prefill window
+        n_a = len(a.tokens_out)
+        engine.step()
+        assert len(a.tokens_out) == n_a + 1
+    engine.drain(timeout_s=120)
+    assert d.result(timeout=1) == reference_tokens(params, cfg, long_p, gd,
+                                                   1, bucket=32)
+    assert a.result(timeout=1) == reference_tokens(params, cfg, short, ga, 0)
+    assert b.result(timeout=1) == reference_tokens(params, cfg, long_p, gb,
+                                                   7, bucket=32)
+    # a sampled chunked admission reproduces its reference too
+    gc = GenerationConfig(max_new_tokens=6, temperature=0.8, top_k=7)
+    c = engine.submit(ServeRequest(input_ids=long_p, gen=gc, seed=3))
+    engine.drain(timeout_s=120)
+    assert c.result(timeout=1) == reference_tokens(params, cfg, long_p, gc,
+                                                   3, bucket=32)
+
+
+# -- backpressure: worst-case page demand refused up front --------------------
+
+
+def test_page_exhaustion_refusal_and_retry_after_release(setup):
+    """Admission control: a submit whose worst-case page demand cannot be
+    covered is refused NOW (ServePagesExhausted with a retry hint) instead
+    of being admitted and failing mid-decode; the same request succeeds
+    after a release frees the pool."""
+    cfg, params = setup
+    engine = make_engine(cfg, params)      # 16 pages; 4 pages/request below
+    gen = GenerationConfig(max_new_tokens=8)
+    assert engine.slots.demand_pages(BUCKET, 8) == 4
+    prompt = [5, 6, 7]
+    handles = [engine.submit(ServeRequest(input_ids=prompt, gen=gen, seed=i))
+               for i in range(4)]          # 16/16 pages reserved (2 queued)
+    with pytest.raises(ServePagesExhausted) as exc:
+        engine.submit(ServeRequest(input_ids=prompt, gen=gen, seed=9))
+    assert exc.value.retry_after_s > 0
+    snap = engine.metrics_snapshot()
+    assert snap["requests_page_refused"] == 1
+    assert snap["requests_rejected"] == 1  # counted in the headline too
+    assert snap["pages_reserved"] == 16
+
+    # a demand the pool can NEVER cover is a 400-class rejection instead
+    with pytest.raises(RequestRejected):
+        engine.submit(ServeRequest(
+            input_ids=prompt, gen=GenerationConfig(max_new_tokens=9)))
+
+    engine.drain(timeout_s=120)            # completions release pages
+    retry = engine.submit(ServeRequest(input_ids=prompt, gen=gen, seed=9))
+    engine.drain(timeout_s=120)
+    assert retry.result(timeout=1) == reference_tokens(params, cfg, prompt,
+                                                       gen, 9)
+    for h in handles:
+        assert len(h.result(timeout=1)) == 8
+
+
+def test_page_exhaustion_maps_to_http_429_with_retry_after(setup):
+    """The frontend maps ServePagesExhausted to HTTP 429 + Retry-After;
+    the client's retry succeeds once the pool drains."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from llama_pipeline_parallel_tpu.serve import ServeLoop
+    from llama_pipeline_parallel_tpu.serve.frontend import make_server
+
+    cfg, params = setup
+    engine = make_engine(cfg, params)
+    server = make_server(engine)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    def post(body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        return urllib.request.urlopen(req, timeout=60)
+
+    gen = dict(max_new_tokens=8)
+    try:
+        # fill the pool in-process (reservations are immediate; no stepping)
+        fillers = [engine.submit(ServeRequest(
+            input_ids=[5, 6], gen=GenerationConfig(max_new_tokens=8),
+            seed=i)) for i in range(4)]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post({"input_ids": [5, 6], "seed": 9, **gen})
+        assert err.value.code == 429
+        assert int(err.value.headers["Retry-After"]) >= 1
+        with ServeLoop(engine, idle_wait_s=0.005):
+            for h in fillers:
+                h.result(timeout=120)      # pool drains
+            out = json.load(post({"input_ids": [5, 6], "seed": 9, **gen}))
+            assert out["tokens"] == reference_tokens(
+                params, cfg, [5, 6], GenerationConfig(max_new_tokens=8), 9)
+    finally:
+        server.shutdown()
+
+
+# -- int8 pages: tolerance gate + capacity ------------------------------------
+
+
+def test_int8_quant_roundtrip_bound():
+    """Per-page scale quantization error bound: |roundtrip - x| <=
+    scale / 127 / 2 when the scale is the block absmax (no saturation)."""
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(4, 8, 2, 16).astype(np.float32))
+    scale = jnp.max(jnp.abs(x), axis=(1, 3))[:, None, :, None]
+    q = decode.quant_page_block(x, scale)
+    rt = np.asarray(decode.dequant_page_block(q, scale, jnp.float32))
+    assert np.all(np.abs(rt - np.asarray(x))
+                  <= np.asarray(scale) / 127.0 * 0.5000001)
+
+
+def test_int8_pages_tolerance_gate_vs_dequantized_reference(setup):
+    """The int8 parity gate: feed BOTH an fp and an int8 paged cache the
+    SAME token stream (the fp path's) and assert the int8 pool's
+    dequantized prompt pages sit within the per-page quantization bound of
+    the fp values, and that the greedy tokens agree along the gated
+    horizon."""
+    cfg, params = setup
+    rs = np.random.RandomState(2)
+    prompt = rs.randint(3, cfg.vocab_size, (6,)).tolist()
+    pad = BUCKET - len(prompt)
+    ids = np.zeros((1, BUCKET), np.int32)
+    ids[0, pad:] = prompt
+    mask = np.zeros((1, BUCKET), np.int32)
+    mask[0, pad:] = 1
+
+    caches = {}
+    for quant in ("fp", "int8"):
+        c = PagedKVCache(cfg, 2, 16, PAGE, 16, quant)
+        c.acquire("r", c.demand_pages(BUCKET, 8))
+        out = decode.prefill_prompt(params, jnp.asarray(ids),
+                                    jnp.asarray(mask), cfg, BUCKET)
+        c.admit(0, out)
+        caches[quant] = (c, out)
+
+    fp_c, fp_out = caches["fp"]
+    q_c, _ = caches["int8"]
+    n = BUCKET // PAGE
+    fp_k = np.asarray(fp_c.pool["k"][:, fp_c.page_table[0, :n]],
+                      dtype=np.float32)
+    qk = np.asarray(q_c.pool["k"][:, q_c.page_table[0, :n]], np.float32)
+    sk = np.asarray(q_c.pool["k_scale"][:, q_c.page_table[0, :n]])
+    deq = qk * (sk[:, :, None, :, None] / 127.0)
+    bound = sk[:, :, None, :, None] / 127.0 * 0.5000001 + 1e-7
+    # the bound only holds where the fp value is real prompt kv; padded
+    # positions are garbage in both pools and excluded by the kv mask
+    valid = np.asarray(fp_c.kv_mask[0, :BUCKET]).reshape(n, PAGE).astype(bool)
+    assert np.all((np.abs(deq - fp_k) <= bound)[:, valid[None].repeat(
+        fp_k.shape[0], 0)[0]])
+
+    # forced-same-stream decode: 6 greedy ticks, int8 fed the fp tokens
+    def tick(c, tok, pos, wp):
+        out = decode.paged_decode_step(
+            params, jnp.asarray([tok, 0], jnp.int32), c.pool,
+            jnp.asarray(c.page_table), jnp.asarray([pos, 0], jnp.int32),
+            jnp.asarray([wp, 0], jnp.int32), c.kv_mask,
+            jnp.asarray([1, 0], jnp.int32), jnp.zeros((2, 2), jnp.uint32),
+            jnp.zeros(2, jnp.float32), jnp.zeros(2, jnp.int32),
+            jnp.ones(2, jnp.float32), cfg)
+        c.update_from_step(out)
+        return int(np.asarray(out["token"])[0])
+
+    tok = int(np.argmax(np.asarray(fp_out["logits"])[0]))
+    pos, wp = int(np.asarray(fp_out["next_pos"])[0]), BUCKET
+    fp_toks, q_toks = [], []
+    for _ in range(6):
+        fp_c.ensure_capacity(0, wp + 1)
+        q_c.ensure_capacity(0, wp + 1)
+        nf = tick(fp_c, tok, pos, wp)
+        q_toks.append(tick(q_c, tok, pos, wp))
+        fp_toks.append(nf)
+        tok, pos, wp = nf, pos + 1, wp + 1
+    assert q_toks == fp_toks, \
+        f"int8 greedy tokens drifted past the gate: {q_toks} vs {fp_toks}"
+
+
+def test_int8_engine_first_token_matches_fp(setup):
+    """Prefill logits are computed unquantized, so the FIRST token of an
+    int8-paged request always equals the fp path's; the rest of the stream
+    completes under the tolerance regime."""
+    cfg, params = setup
+    prompt = np.random.RandomState(3).randint(3, 250, (6,)).tolist()
+    gen = GenerationConfig(max_new_tokens=5)
+    outs = {}
+    for quant in ("fp", "int8"):
+        engine = make_engine(cfg, params, kv_quant=quant)
+        h = engine.submit(ServeRequest(input_ids=prompt, gen=gen, seed=0))
+        engine.drain(timeout_s=60)
+        outs[quant] = h.result(timeout=1)
+    assert len(outs["int8"]) == 5
+    assert outs["int8"][0] == outs["fp"][0]
+
+
+def test_paged_capacity_2x_and_int8_4x_at_dense_hbm_budget(setup):
+    """THE capacity assertion: at the dense cache's resident HBM budget
+    (2 slots x 64 tokens), the paged pool admits >= 2x the dense cache's
+    concurrent requests, and int8 pages >= 4x — because demand is charged
+    per request (prompt + budget), not one worst case per slot."""
+    cfg, params = setup
+    dense_slots, dense_len, page = 2, 64, 8
+    budget_bytes = dense_kv_cache_bytes(cfg, dense_slots, dense_len)
+    gen = GenerationConfig(max_new_tokens=9)   # bucket 8 + 8 writes: 2 pages
+    prompt = [5, 6, 7]
+
+    active = {}
+    for quant, factor in (("fp", 2), ("int8", 4)):
+        num_pages = 1
+        while paged_pool_bytes(cfg, num_pages + 1, page, quant) \
+                <= budget_bytes:
+            num_pages += 1
+        assert paged_pool_bytes(cfg, num_pages, page, quant) <= budget_bytes
+        engine = make_engine(
+            cfg, params, max_slots=4 * dense_slots * dense_len // 16,
+            max_len=dense_len, page_size=page, num_pages=num_pages,
+            kv_quant=quant, max_queue=64)
+        admitted = 0
+        while True:
+            try:
+                engine.submit(ServeRequest(input_ids=prompt, gen=gen,
+                                           seed=admitted))
+            except ServePagesExhausted:
+                break
+            admitted += 1
+        engine._advance_prefill()     # place them all into live slots
+        active[quant] = engine.slots.active_count
+        assert engine.slots.active_count == admitted
+        assert admitted >= factor * dense_slots, \
+            (f"{quant} pool at the dense budget admitted {admitted} < "
+             f"{factor}x dense's {dense_slots}")
+        engine.shutdown()
+    assert active["int8"] >= 2 * active["fp"]
+
+
+# -- telemetry ---------------------------------------------------------------
+
+
+def test_serving_report_renders_page_gauges(tmp_path, capsys):
+    import serving_report  # tools/ on sys.path via conftest
+
+    line = {"step": 3, "serving": 1, "requests_completed": 3,
+            "requests_rejected": 1, "requests_page_refused": 1,
+            "ttft_p50_ms": 12.0, "active_slots": 1, "queue_depth": 0,
+            "slot_allocations": 1, "kv_cache": "paged", "kv_quant": "int8",
+            "page_size": 4, "pages_total": 16, "pages_used": 3,
+            "pages_free": 13, "pages_reserved": 4, "page_allocations": 9,
+            "prefill_chunks_last_tick": 1, "prefill_chunks_total": 7,
+            "prefill_tokens_total": 88, "prefilling": 0}
+    with open(tmp_path / "metrics.jsonl", "w") as f:
+        f.write(json.dumps(line) + "\n")
+    with open(tmp_path / "spans.jsonl", "w") as f:
+        f.write(json.dumps({"name": "serve_request", "ts": 1.0, "end": 2.0,
+                            "dur": 1.0, "ttft": 0.1, "tpot": 0.01,
+                            "queue_wait": 0.0, "tokens": 4}) + "\n")
+    assert serving_report.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "pages_used=3" in out and "pages_reserved=4" in out
+    assert "requests_page_refused=1" in out
+    assert "prefill_chunks_last_tick=1" in out

@@ -161,6 +161,9 @@ def test_continuous_batching_token_parity_and_telemetry(setup, tmp_path):
     assert last["tokens_generated"] == sum(g.max_new_tokens for g in gens)
 
 
+@pytest.mark.slow  # the paged grid's eos row (test_paged_serving.py::
+# test_paged_eos_finishes_row_early_and_frees_pages) pins the same early-
+# free semantics every tier-1 run; this dense twin stays in the round gate
 def test_eos_finishes_row_early_and_frees_slot(setup):
     """A request hitting eos frees its slot before the budget; the emitted
     stream ends with the eos token, matching generate()'s pre-pad prefix."""
@@ -310,6 +313,10 @@ def test_percentile_helpers():
 # -- in-process loop + HTTP front-end ---------------------------------------
 
 
+@pytest.mark.slow  # ServeLoop streaming now runs every tier-1 lane under
+# real load via test_serve_traffic.py::test_run_trace_against_chunked_paged_
+# engine (plus the HTTP test below); this focused dense rep joins the round
+# gate
 def test_serve_loop_streams_tokens(setup):
     """ServeLoop drives the engine in the background; the handle streams
     tokens as they are produced and the stream matches the result."""
@@ -439,6 +446,10 @@ def _post(port: int, body: dict, timeout: float = 120.0):
     return json.load(urllib.request.urlopen(req, timeout=timeout))
 
 
+@pytest.mark.slow  # ~40 s of real process spawns/kills — the heavyweight
+# chaos leg the CI gate note already earmarks for the round gate; its
+# machinery (supervisor restart, serve.json discovery, role ledger) is
+# untouched by the paged-cache work that funds this rebalance
 def test_multi_replica_supervised_restart(setup, tmp_path):
     """Two serve replicas under tools/supervisor.py from ONE checkpoint;
     replica A is SIGKILLed mid-decode, the watchdog restarts it from the

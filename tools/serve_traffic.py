@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Synthetic serving traffic: Poisson arrivals, prompt/output length mixes.
+
+Turns the serving tier's SLO claims into measured curves: a seeded,
+deterministic request trace (exponential inter-arrival gaps at `--rate`
+requests/s; prompt and output lengths drawn from weighted mixes like
+`"64:0.7,256:0.3"`) is replayed against a live `ServeEngine` in-process,
+and the run summary reports what the engine actually did under load —
+completions, page/queue refusals, TTFT/TPOT percentiles, prefill-chunk
+cadence. bench.py's `extra:serve-prefill-*` row and
+tests/test_serve_traffic.py drive the same library functions
+(`poisson_trace` / `run_trace`), so the mix recorded in a bench row's
+metadata is exactly what generated its load.
+
+    python tools/serve_traffic.py --checkpoint_dir /ckpts/run1 \
+        --rate 8 --requests 64 --prompt_mix 64:0.6,256:0.4 \
+        --output_mix 16:0.5,64:0.5 --kv_cache paged --page_size 64 \
+        --prefill_chunk_tokens 256
+
+Determinism: the trace depends only on (seed, rate, n, mixes) — two runs
+against the same checkpoint see identical arrivals, prompts, and sampling
+seeds. Wall-clock replay obviously isn't deterministic; the trace is.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficRequest:
+    arrival_s: float        # offset from trace start
+    prompt_len: int
+    max_new_tokens: int
+    seed: int
+
+
+def parse_mix(spec: str) -> tuple[tuple[int, float], ...]:
+    """`"64:0.7,256:0.3"` -> ((64, 0.7), (256, 0.3)), weights normalized.
+    A bare `"64"` means a single length at weight 1."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        length, _, weight = part.partition(":")
+        out.append((int(length), float(weight) if weight else 1.0))
+    if not out:
+        raise ValueError(f"empty length mix {spec!r}")
+    total = sum(w for _, w in out)
+    if total <= 0 or any(w < 0 for _, w in out) or any(n < 1 for n, _ in out):
+        raise ValueError(f"mix {spec!r} needs positive lengths and "
+                         f"non-negative weights summing > 0")
+    return tuple((n, w / total) for n, w in out)
+
+
+def mix_label(mix: tuple[tuple[int, float], ...]) -> str:
+    """Canonical `len:weight` string — the form bench rows record."""
+    return ",".join(f"{n}:{round(w, 4)}" for n, w in mix)
+
+
+def poisson_trace(seed: int, rate_rps: float, n_requests: int,
+                  prompt_mix, output_mix) -> list[TrafficRequest]:
+    """A deterministic Poisson arrival trace: exponential inter-arrival
+    gaps at `rate_rps`, lengths drawn independently from the two mixes.
+    Each request carries its own sampling seed (derived from the trace
+    seed), so replaying a trace is reproducible end-to-end."""
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    if n_requests < 1:
+        raise ValueError(f"n_requests must be >= 1, got {n_requests}")
+    rs = np.random.RandomState(seed)
+    gaps = rs.exponential(1.0 / rate_rps, size=n_requests)
+    arrivals = np.cumsum(gaps) - gaps[0]          # first request at t=0
+    p_lens = [n for n, _ in prompt_mix]
+    p_w = [w for _, w in prompt_mix]
+    o_lens = [n for n, _ in output_mix]
+    o_w = [w for _, w in output_mix]
+    return [
+        TrafficRequest(
+            arrival_s=float(arrivals[i]),
+            prompt_len=int(rs.choice(p_lens, p=p_w)),
+            max_new_tokens=int(rs.choice(o_lens, p=o_w)),
+            seed=int(rs.randint(0, 2**31 - 1)))
+        for i in range(n_requests)
+    ]
+
+
+def run_trace(engine, trace_requests, time_scale: float = 1.0,
+              prompt_token_low: int = 3,
+              result_timeout_s: float = 300.0) -> dict:
+    """Replay a trace against a live engine (a ServeLoop is started for
+    the duration): submit each request at its (scaled) arrival offset,
+    count refusals by kind, wait for every accepted request, and return
+    the run summary. Prompt token ids are drawn deterministically from
+    the request's seed."""
+    from llama_pipeline_parallel_tpu.models.llama.decode import (
+        GenerationConfig,
+    )
+    from llama_pipeline_parallel_tpu.serve import (
+        RequestRejected,
+        ServeLoop,
+        ServeOverloaded,
+        ServePagesExhausted,
+        ServeRequest,
+    )
+
+    vocab = engine.cfg.vocab_size
+    handles = []
+    refused_pages = refused_overload = rejected = 0
+    t0 = time.monotonic()
+    with ServeLoop(engine, idle_wait_s=0.002):
+        for tr in trace_requests:
+            target = t0 + tr.arrival_s * time_scale
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            prompt = np.random.RandomState(tr.seed).randint(
+                prompt_token_low, vocab, size=tr.prompt_len).tolist()
+            req = ServeRequest(
+                input_ids=prompt,
+                gen=GenerationConfig(max_new_tokens=tr.max_new_tokens),
+                seed=tr.seed)
+            try:
+                handles.append(engine.submit(req))
+            except ServePagesExhausted:
+                refused_pages += 1
+            except ServeOverloaded:
+                refused_overload += 1
+            except RequestRejected:
+                rejected += 1
+        for h in handles:
+            try:
+                h.result(timeout=result_timeout_s)
+            except Exception:
+                pass  # counted via the engine's failed/rejected counters
+    wall = time.monotonic() - t0
+    snap = engine.metrics_snapshot()
+    summary = {
+        "requests": len(trace_requests),
+        "submitted": len(handles),
+        "refused_pages": refused_pages,
+        "refused_overload": refused_overload,
+        "rejected_shape": rejected,
+        "wall_s": round(wall, 3),
+        **{k: snap[k] for k in snap
+           if k.startswith(("ttft_", "tpot_", "queue_wait_"))
+           or k in ("requests_completed", "requests_failed",
+                    "tokens_generated", "prefill_chunks_total",
+                    "prefill_tokens_total", "pages_total")},
+    }
+    if wall > 0:
+        summary["tokens_per_sec"] = round(
+            snap.get("tokens_generated", 0) / wall, 2)
+    return summary
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--platform", default=None)
+    p.add_argument("--checkpoint_dir", required=True)
+    p.add_argument("--step", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--rate", type=float, default=4.0, help="requests/s")
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--prompt_mix", default="64:0.7,256:0.3")
+    p.add_argument("--output_mix", default="16:0.5,64:0.5")
+    p.add_argument("--time_scale", type=float, default=1.0,
+                   help="replay arrivals at 1/time_scale speed")
+    # engine shape (mirrors tools/serve.py)
+    p.add_argument("--max_slots", type=int, default=8)
+    p.add_argument("--max_len", type=int, default=2048)
+    p.add_argument("--buckets", default="64,128,256,512,1024")
+    p.add_argument("--max_queue", type=int, default=64)
+    p.add_argument("--kv_cache", default="dense", choices=("dense", "paged"))
+    p.add_argument("--page_size", type=int, default=64)
+    p.add_argument("--num_pages", type=int, default=None)
+    p.add_argument("--kv_quant", default="fp", choices=("fp", "int8"))
+    p.add_argument("--prefill_chunk_tokens", type=int, default=0)
+    args = p.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import (
+        load_module_checkpoint,
+    )
+    from llama_pipeline_parallel_tpu.serve import ServeConfig, ServeEngine
+
+    prompt_mix = parse_mix(args.prompt_mix)
+    output_mix = parse_mix(args.output_mix)
+    params, cfg, _, step = load_module_checkpoint(args.checkpoint_dir,
+                                                  args.step)
+    engine = ServeEngine(params, cfg, ServeConfig(
+        max_slots=args.max_slots, max_len=args.max_len,
+        prompt_buckets=tuple(int(b) for b in args.buckets.split(",")),
+        max_queue=args.max_queue, kv_cache=args.kv_cache,
+        page_size=args.page_size, num_pages=args.num_pages,
+        kv_quant=args.kv_quant,
+        prefill_chunk_tokens=args.prefill_chunk_tokens))
+    trace_requests = poisson_trace(args.seed, args.rate, args.requests,
+                                   prompt_mix, output_mix)
+    summary = run_trace(engine, trace_requests, time_scale=args.time_scale)
+    summary["mix"] = {"prompt": mix_label(prompt_mix),
+                      "output": mix_label(output_mix),
+                      "rate_rps": args.rate, "seed": args.seed}
+    summary["checkpoint_step"] = step
+    engine.shutdown()
+    print(json.dumps(summary, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -121,6 +121,22 @@ def main(argv: list[str] | None = None) -> int:
                       "active_slots", "queue_depth", "slot_allocations",
                       "decode_steps") if k in last}
         print("  " + " ".join(f"{k}={v}" for k, v in occupancy.items()))
+        if last.get("kv_cache") == "paged":
+            # the paged-capacity picture next to the SLOs: pool occupancy,
+            # worst-case reservations, the admission-refusal counter, and
+            # the prefill-chunk cadence (docs/SERVING.md "Paged KV cache")
+            pages = {k: last.get(k) for k in
+                     ("pages_used", "pages_reserved", "pages_total",
+                      "page_size", "kv_quant", "page_allocations",
+                      "requests_page_refused") if k in last}
+            print("  page pool: " + " ".join(f"{k}={v}"
+                                             for k, v in pages.items()))
+            chunks = {k: last.get(k) for k in
+                      ("prefill_chunks_last_tick", "prefill_chunks_total",
+                       "prefill_tokens_total", "prefilling") if k in last}
+            if chunks:
+                print("  prefill:   " + " ".join(f"{k}={v}"
+                                                 for k, v in chunks.items()))
     if rep["health_goodput"] is not None:
         print(f"\n  serve goodput (health.json): "
               f"{100 * rep['health_goodput']:.1f}%")
