@@ -32,10 +32,119 @@ shape-comparable):
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
 import time
+
+
+def _parse_args(argv=None):
+    """CLI surface (env vars keep working; flags win where both exist):
+
+    --full-trajectory  the one-shot runbook: force every `extra:*` row
+                       family ON (sched-*, layout-*, offload-*, kernel-*,
+                       serve-*) regardless of the BENCH_* env toggles and
+                       write every row into the perf ledger — the "first
+                       reachable-TPU run records everything in one pass"
+                       mode, runnable end-to-end on CPU today.
+    --perf-ledger P    append utils/perf.py rows (model-vs-measured pairs
+                       per row, reason-tagged failure rows for probe
+                       failures) to P; defaults to ./perf.jsonl under
+                       --full-trajectory.
+    --row-budget-s B   per-row wall budget for the extras families: a new
+                       family may start only while the extras wall stays
+                       within B x rows-completed (+1); families skipped by
+                       an exhausted budget land in the ledger as
+                       reason-tagged rows, so perf_report can tell
+                       "skipped" from "never attempted".
+    """
+    p = argparse.ArgumentParser(add_help=False)
+    p.add_argument("--full-trajectory", action="store_true")
+    p.add_argument("--perf-ledger", default=os.environ.get("BENCH_PERF_LEDGER"))
+    p.add_argument("--row-budget-s", type=float,
+                   default=float(os.environ.get("BENCH_ROW_BUDGET_S", "0") or 0))
+    args, _ = p.parse_known_args(argv)
+    if args.full_trajectory:
+        for var in ("BENCH_EXTRAS", "BENCH_SCHEDULES", "BENCH_LAYOUT",
+                    "BENCH_OFFLOAD", "BENCH_KERNELS", "BENCH_SERVING"):
+            os.environ[var] = "1"
+        if not args.perf_ledger:
+            args.perf_ledger = "perf.jsonl"
+    return args
+
+
+class _RowBudget:
+    """Per-row wall budget over the extras families (--row-budget-s).
+    `allow(name)` gates each family: permitted only while the extras wall
+    is within budget x (rows completed so far + 1) — one overrunning row
+    eats the later families' budget instead of the harness's patience.
+    Skips are recorded for the ledger."""
+
+    def __init__(self, per_row_s: float, count_rows=None):
+        self.per_row = per_row_s
+        self.t0 = None
+        self._count = count_rows or (lambda: 0)
+        self._initial = 0
+        self.skipped: list[str] = []
+
+    def start(self) -> None:
+        self.t0 = time.perf_counter()
+        self._initial = self._count()
+
+    def allow(self, name: str) -> bool:
+        if not self.per_row or self.t0 is None:
+            return True
+        rows_done = max(self._count() - self._initial, 0)
+        elapsed = time.perf_counter() - self.t0
+        if elapsed <= self.per_row * (rows_done + 1):
+            return True
+        print(f"bench row family {name} skipped: extras wall "
+              f"{elapsed:.0f}s exceeds the --row-budget-s {self.per_row:.0f}"
+              f"s x {rows_done + 1} rows", file=sys.stderr, flush=True)
+        self.skipped.append(name)
+        return False
+
+
+def _write_ledger(path: str | None, summary: dict | None,
+                  skipped: list[str], error: str | None = None) -> None:
+    """Append this round's rows to the perf ledger (--perf-ledger): the
+    model-vs-measured pairs of a healthy round, or ONE reason-tagged
+    failure row for a probe-failed round — BENCH_r0*-style history stays
+    summarizable by tools/perf_report.py either way. Never raises: the
+    measurement JSON line is already out when this runs."""
+    if not path:
+        return
+    try:
+        from llama_pipeline_parallel_tpu.utils import perf
+
+        label = os.environ.get("BENCH_RUN_LABEL") or \
+            f"bench-{time.strftime('%Y%m%d-%H%M%S')}"
+        if error is not None:
+            rows = [perf.make_row("bench_round", source="bench", run=label,
+                                  reason=error)]
+        else:
+            rows = perf.rows_from_bench_summary(summary or {}, run=label)
+            # stamp the backend: a CPU smoke's mfu/host-bw are real numbers
+            # about the WRONG hardware — derive_calibration must not feed
+            # them into preflight's TPU model constants
+            try:
+                import jax
+
+                backend = jax.default_backend()
+                for row in rows:
+                    row.setdefault("context", {})["backend"] = backend
+            except Exception:
+                pass
+        rows += [perf.make_row("bench_row_family", source="bench", run=label,
+                               reason=f"skipped: row budget exhausted "
+                                      f"before {name}")
+                 for name in skipped]
+        n = perf.append_rows(path, rows)
+        print(f"perf ledger: {n} row(s) appended to {path}",
+              file=sys.stderr, flush=True)
+    except Exception as e:
+        print(f"perf ledger write failed: {e!r}", file=sys.stderr, flush=True)
 
 
 def _watchdog(seconds: int, report):
@@ -108,8 +217,10 @@ def _probe_devices(timeout_s: float) -> str | None:
 
 
 def main() -> None:
+    cli = _parse_args()
     results: dict[str, dict] = {}  # name -> {"dt": s/step, "tokens_per_step": n}
     summary_ctx: dict = {}
+    row_budget = _RowBudget(cli.row_budget_s, count_rows=lambda: len(results))
 
     def report():
         # extras (offload/packed/long-seq rows) are excluded from the
@@ -166,6 +277,10 @@ def main() -> None:
             "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": f"no usable accelerator: {probe_err}",
         }), flush=True)
+        # probe-failure rounds land in the ledger as reason-tagged rows so
+        # perf_report can summarize "N rounds unreachable" from history
+        _write_ledger(cli.perf_ledger, None, [],
+                      error=f"no usable accelerator: {probe_err}")
         # the probe thread may still be wedged inside the runtime — a plain
         # sys.exit would hang interpreter shutdown on it
         os._exit(2)
@@ -371,6 +486,7 @@ def main() -> None:
     # Run AFTER the sweep so a wedge here still reports the full headline;
     # BENCH_EXTRAS=0 skips them.
     if os.environ.get("BENCH_EXTRAS", "1") != "0":
+        row_budget.start()  # the per-row wall budget covers the extras families
         bs_big = max(batches)
         long_seq = 2048 if os.environ.get("BENCH_MODEL") != "tiny" else seq * 2
 
@@ -410,7 +526,7 @@ def main() -> None:
         # unreachable, which is exactly why these rows sit behind the same
         # fail-fast probe as the headline). Non-headline: a pp-ring step at
         # these shapes is not tokens/s-comparable with the pp1 sweep.
-        if os.environ.get("BENCH_SCHEDULES", "1") != "0":
+        if os.environ.get("BENCH_SCHEDULES", "1") != "0" and row_budget.allow("sched"):
             n_dev = jax.device_count()
             pp_s = 4 if n_dev >= 4 else n_dev
             m_s = int(os.environ.get("BENCH_SCHED_MICROBATCHES", "8"))
@@ -422,11 +538,11 @@ def main() -> None:
                 sched_mesh = make_mesh(MeshConfig(pp=pp_s))
                 sbatch = make_batch(m_s)  # one row per microbatch
                 stacked_by_v: dict[int, tuple] = {}  # v -> (manifest, params)
-            for sched, v_s in ((("1f1b", 1), ("interleaved_1f1b", 2),
+            for sched_name, v_s in ((("1f1b", 1), ("interleaved_1f1b", 2),
                                 ("zb1", 2), ("solver", 2))
                                if pp_s >= 2 else ()):
                 if cfg.num_hidden_layers % (pp_s * v_s) or m_s % pp_s:
-                    print(f"bench schedule row {sched} skipped: "
+                    print(f"bench schedule row {sched_name} skipped: "
                           f"{cfg.num_hidden_layers} layers / m={m_s} do not "
                           f"fit pp={pp_s} v={v_s}", file=sys.stderr, flush=True)
                     continue
@@ -438,7 +554,7 @@ def main() -> None:
                                              pl.stack_stages(canonical, man_s))
                     man_s, stacked_s = stacked_by_v[v_s]
                     seq_s = None
-                    if sched == "solver":
+                    if sched_name == "solver":
                         # the list scheduler's drain-interleaved W variant:
                         # canonical zb1 bubble, compressed W queue — the
                         # measured point for the solver lane next to the
@@ -451,7 +567,7 @@ def main() -> None:
                                                      w_placement="drain")
                     pcfg_s = pl.PipelineConfig(
                         num_stages=pp_s, num_microbatches=m_s,
-                        schedule=sched, virtual_stages=v_s,
+                        schedule=sched_name, virtual_stages=v_s,
                         unit_schedule=seq_s)
                     fn = jax.jit(pl.make_pipeline_loss_and_grad(
                         sched_mesh, cfg, pcfg_s, stacked_s))
@@ -463,19 +579,19 @@ def main() -> None:
                     if not np.isfinite(last):
                         raise ValueError(f"non-finite loss {last}")
                     detail = {
-                        "schedule": sched, "pp": pp_s,
+                        "schedule": sched_name, "pp": pp_s,
                         "virtual_stages": v_s, "microbatches": m_s,
                         "bubble_fraction_analytic":
                             round(pl.bubble_fraction(pcfg_s), 4)}
                     if pl.wgrad_queue_peak(pcfg_s):
                         detail["wgrad_queue_depth"] = pl.wgrad_queue_peak(pcfg_s)
-                    if sched == "solver":
+                    if sched_name == "solver":
                         detail["sequence"] = seq_s.label
-                    results[f"extra:sched-{sched},pp={pp_s}"] = {
+                    results[f"extra:sched-{sched_name},pp={pp_s}"] = {
                         "dt": dt, "tokens_per_step": m_s * seq,
                         "headline": False, "detail": detail}
                 except Exception as e:
-                    print(f"bench schedule row {sched} pp={pp_s} v={v_s} "
+                    print(f"bench schedule row {sched_name} pp={pp_s} v={v_s} "
                           f"failed: {e!r}", file=sys.stderr, flush=True)
 
         # Cost-model auto-layout rows (BENCH_LAYOUT=0 skips): the generated
@@ -488,7 +604,7 @@ def main() -> None:
         # no-live-perf-number gap). Behind the same fail-fast probe as
         # everything else; on the CPU virtual mesh the absolute numbers are
         # meaningless but the rows prove the machinery end-to-end.
-        if os.environ.get("BENCH_LAYOUT", "1") != "0":
+        if os.environ.get("BENCH_LAYOUT", "1") != "0" and row_budget.allow("layout"):
             try:
                 sys.path.insert(0, os.path.join(os.path.dirname(
                     os.path.abspath(__file__)), "tools"))
@@ -573,7 +689,7 @@ def main() -> None:
         # offload point. Behind the same fail-fast probe as everything
         # else; on CPU the transfers are gated off (utils/host_stash.py),
         # so the rows exist but measure the restructured schedule only.
-        if os.environ.get("BENCH_OFFLOAD", "1") != "0":
+        if os.environ.get("BENCH_OFFLOAD", "1") != "0" and row_budget.allow("offload"):
             try:
                 from llama_pipeline_parallel_tpu.utils import host_stash
 
@@ -646,7 +762,7 @@ def main() -> None:
         # interpret mode: the rows exist, the delta is meaningless and the
         # twin comparison is the parity smoke). Behind the same fail-fast
         # probe as everything else.
-        if os.environ.get("BENCH_KERNELS", "1") != "0":
+        if os.environ.get("BENCH_KERNELS", "1") != "0" and row_budget.allow("kernel"):
             try:
                 from llama_pipeline_parallel_tpu.ops.pallas_ce import (
                     ce_head_traffic_bytes,
@@ -720,7 +836,7 @@ def main() -> None:
         # docs/SERVING.md's SLOs are made of. Same fail-fast posture as the
         # other extras: a failure here reports, never wedges the headline
         # (the up-front device probe already ran).
-        if os.environ.get("BENCH_SERVING", "1") != "0":
+        if os.environ.get("BENCH_SERVING", "1") != "0" and row_budget.allow("serve"):
             try:
                 from llama_pipeline_parallel_tpu.models.llama.decode import (
                     GenerationConfig,
@@ -899,8 +1015,11 @@ def main() -> None:
             "metric": "tokens_per_sec_per_chip", "value": 0.0,
             "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": "every bench configuration failed"}), flush=True)
+        _write_ledger(cli.perf_ledger, None, row_budget.skipped,
+                      error="every bench configuration failed")
         sys.exit(1)
     print(json.dumps(summary), flush=True)
+    _write_ledger(cli.perf_ledger, summary, row_budget.skipped)
 
     # BENCH_PROFILE=<dir>: afterwards (the result JSON is already out, so a
     # profiling failure or wedge can no longer cost the measurement), capture

@@ -1155,12 +1155,40 @@ def _unit_schedule_for(pcfg: PipelineConfig):
                              pcfg.offload_wgrad, counts)
 
 
+def flush_unit_schedule(pcfg: PipelineConfig):
+    """The PER-FLUSH unit sequence this config's interpreter executes —
+    the schedule observatory's plan source (utils/timeline.py keys its
+    measured segment durations against this sequence's segment
+    decomposition, so the timed boundaries and the compiled scans share
+    one grouping). None for gpipe (no unit sequence)."""
+    if pcfg.schedule not in UNIT_SCHEDULES:
+        return None
+    return _unit_schedule_for(dataclasses.replace(
+        pcfg, num_microbatches=pcfg.num_microbatches // pcfg.accum_chunks,
+        accum_chunks=1))
+
+
 @functools.lru_cache(maxsize=64)
 def _canonical_cached(schedule: str, m: int, s: int, v: int,
                       offload_wgrad: bool, stage_costs: tuple | None = None):
     return usched.canonical_schedule(schedule, m, s, v,
                                      offload_wgrad=offload_wgrad,
                                      stage_costs=stage_costs)
+
+
+def _timeline_mark(boundary: int, stage, probe):
+    """One timeline boundary mark (utils/timeline.py): a host callback
+    recording (boundary, stage, perf_counter) when THIS device's execution
+    reaches the boundary. Returns a f32 scalar (always 0.0) the caller must
+    fold back into the live carry — the data dependence is what pins the
+    callback's schedule position (and keeps DCE off it); `jnp.where(ts <
+    inf, x, 0)` returns x bit-exactly, so timeline mode ON never changes a
+    value, only adds the boundary sync."""
+    from llama_pipeline_parallel_tpu.utils import timeline as tl
+
+    return jax.pure_callback(
+        tl.mark_callback, jax.ShapeDtypeStruct((), jnp.float32),
+        jnp.int32(boundary), stage, probe)
 
 
 def _pipeline_units_local(
@@ -1172,6 +1200,7 @@ def _pipeline_units_local(
     global_count: jnp.ndarray,
     us,
     collect_stats: bool = False,
+    timeline_marks: bool = False,
 ) -> tuple:
     """The unit-sequence INTERPRETER: executes any validated UnitSchedule
     (parallel/schedule.py) inside shard_map — the single replacement for
@@ -1563,30 +1592,45 @@ def _pipeline_units_local(
                                           cfg.dtype))
         carry = carry + wq0
 
-    flags = list(zip(us.has_f.tolist(), us.has_b.tolist(),
-                     us.has_w.tolist(), us.ring_fwd.tolist(),
-                     us.ring_bwd.tolist()))
-    t0 = 0
-    while t0 < len(flags):
-        t1 = t0
-        while t1 < len(flags) and flags[t1] == flags[t0]:
-            t1 += 1
-        has_f, has_b, has_w, r_f, r_b = flags[t0]
-        if has_w and not (has_f or has_b):
+    def boundary_mark(bidx: int, carry):
+        """Timeline boundary (opt-in, `timeline.enabled`): record this
+        stage's wall clock at the edge between two compiled segments, then
+        tie the returned scalar back into the small carry heads so the
+        callback is scheduled exactly at the boundary (and survives DCE).
+        The where-select returns its operand unchanged — timeline ON is
+        value-identical to OFF, and OFF compiles no callback at all (the
+        jaxpr pin in tests/test_timeline.py)."""
+        if not timeline_marks:
+            return carry
+        x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats, *wq = carry
+        probe = (x_recv[0, 0, 0].astype(jnp.float32)
+                 + dy_recv[0, 0, 0].astype(jnp.float32) + loss_acc
+                 + jax.tree.leaves(gacc)[0].ravel()[0])
+        ts = _timeline_mark(bidx, stage, probe)
+        keep = ts < jnp.float32(float("inf"))
+        x_recv = jnp.where(keep, x_recv, jnp.zeros_like(x_recv))
+        dy_recv = jnp.where(keep, dy_recv, jnp.zeros_like(dy_recv))
+        loss_acc = jnp.where(keep, loss_acc, jnp.zeros_like(loss_acc))
+        return (x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats, *wq)
+
+    carry = boundary_mark(0, carry)
+    for seg in usched.segments(us):
+        if seg.has_w and not (seg.has_f or seg.has_b):
             x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats, *wq = carry
-            gacc = run_w_segment(t0, t1, gacc, tuple(wq))
+            gacc = run_w_segment(seg.t0, seg.t1, gacc, tuple(wq))
             carry = (x_recv, dy_recv, xbuf, gacc, loss_acc, act_stats, *wq)
         else:
             xs = {}
-            if has_f:
-                xs["f"] = f_tbl[t0:t1]
-            if has_b:
-                xs["b"] = b_tbl[t0:t1]
-            if has_w:
-                xs["w"] = w_tbl[t0:t1]
+            if seg.has_f:
+                xs["f"] = f_tbl[seg.t0:seg.t1]
+            if seg.has_b:
+                xs["b"] = b_tbl[seg.t0:seg.t1]
+            if seg.has_w:
+                xs["w"] = w_tbl[seg.t0:seg.t1]
             carry, _ = jax.lax.scan(
-                make_seg_body(has_f, has_b, has_w, r_f, r_b), carry, xs)
-        t0 = t1
+                make_seg_body(seg.has_f, seg.has_b, seg.has_w,
+                              seg.ring_fwd, seg.ring_bwd), carry, xs)
+        carry = boundary_mark(seg.index + 1, carry)
     _, _, _, grads, loss_acc, act_stats, *_ = carry
 
     # loss_acc is nonzero on the last stage only (cond zero branch elsewhere)
@@ -1596,7 +1640,7 @@ def _pipeline_units_local(
 
 
 def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn,
-                         collect_stats=False):
+                         collect_stats=False, timeline_marks=False):
     """shard_map body: global-mean loss + fully reduced grads (+ per-stage
     activation stats when `collect_stats` — see utils/numerics.py).
 
@@ -1628,7 +1672,8 @@ def _loss_and_grad_local(params, batch, cfg, pcfg, attn_fn,
         def chunk_loss_and_grad(p, chunk_batch):
             out = _pipeline_units_local(p, chunk_batch, cfg, chunk_pcfg,
                                         attn_fn, global_count, us,
-                                        collect_stats=collect_stats)
+                                        collect_stats=collect_stats,
+                                        timeline_marks=timeline_marks)
             return out if collect_stats else (*out, _sched_act_stats_zero(pcfg))
     else:
         def chunk_loss(p, chunk_batch):
@@ -1772,6 +1817,7 @@ def make_pipeline_loss_and_grad(
     params_like: Params,
     attn_fn: Callable = attention,
     collect_stats: bool = False,
+    timeline_segments: bool = False,
 ) -> Callable[[Params, Batch], tuple]:
     """Build the (jit-able) SPMD loss+grad function over stage-stacked params.
 
@@ -1780,7 +1826,19 @@ def make_pipeline_loss_and_grad(
     per-stage stage-boundary activation stats, `{"act_absmax_per_stage",
     "act_rms_per_stage"}` as [num_stages] arrays sharded over pp — computed
     in-graph (utils/numerics.py; no host round-trip).
+    `timeline_segments` (the schedule observatory, utils/timeline.py)
+    compiles a host-callback boundary mark between the interpreter's
+    segment scans so the trainer can attribute a step's measured wall to
+    warmup/steady/drain/W-drain per stage; values are bit-identical either
+    way, and OFF (the default) compiles no callback at all — the program
+    is the same jaxpr as before the observatory existed. Unit-sequence
+    schedules only (gpipe's scan has no segment boundaries to mark).
     """
+    if timeline_segments and pcfg.schedule not in UNIT_SCHEDULES:
+        raise ValueError(
+            f"timeline.enabled needs a unit-sequence schedule "
+            f"({UNIT_SCHEDULES}); {pcfg.schedule!r} has no segment "
+            f"boundaries to time")
     if mesh.shape[AXIS_PP] != pcfg.num_stages:
         raise ValueError(
             f"PipelineConfig.num_stages={pcfg.num_stages} does not match the "
@@ -1882,7 +1940,8 @@ def make_pipeline_loss_and_grad(
         out_specs += (stats_specs,)
     fn = shard_map(
         partial(_loss_and_grad_local, cfg=cfg, pcfg=pcfg, attn_fn=attn_fn,
-                collect_stats=collect_stats),
+                collect_stats=collect_stats,
+                timeline_marks=timeline_segments),
         mesh=mesh,
         in_specs=(param_specs, batch_specs(mesh)),
         out_specs=out_specs,

@@ -285,10 +285,20 @@ class _Prefilling:
 
 class ServeEngine:
     def __init__(self, params: dict, cfg: LlamaConfig, serve_cfg: ServeConfig,
-                 metrics_writer=None):
+                 metrics_writer=None, timeline=None, profiler=None,
+                 slo=None):
         """`params` in the CANONICAL (unstacked) layout —
         `ckpt.load_module_checkpoint` hands them out straight from any
-        training checkpoint (the train->serve handoff)."""
+        training checkpoint (the train->serve handoff).
+
+        Observatory hooks (docs/OBSERVABILITY.md): `timeline` (a
+        utils/timeline.TimelineWriter) gets one record per engine tick —
+        the prefill-chunk vs decode-step wall split, with the mid-prefill
+        request named — the serving counterpart of the trainer's
+        per-segment timeline. `slo` (telemetry.SLOThresholds) checks every
+        completed request; a breach bumps `slo_breaches` and fires
+        `profiler` (utils/profiler.TriggeredProfiler), whose bounded
+        capture window advances one tick per `step()`."""
         self.params = params
         self.cfg = cfg
         self.serve_cfg = serve_cfg
@@ -303,6 +313,10 @@ class ServeEngine:
                                      serve_cfg.max_len)
         self.stats = SLOStats()
         self._metrics_writer = metrics_writer
+        self._timeline = timeline
+        self._profiler = profiler
+        self._slo = slo
+        self._last_decode_dur = 0.0
         self._occupants: dict[int, _Running] = {}
         self._prefilling: deque = deque()   # paged chunked admissions
         self._queue: deque = deque()
@@ -390,10 +404,16 @@ class ServeEngine:
         budget: whole prompts) or advance bounded prefill chunks (paged
         with one), then one decode tick over all slots. Returns False when
         there was nothing to do (caller may sleep)."""
+        t0 = time.perf_counter() if self._timeline is not None else 0.0
+        pf_req = (self._prefilling[0].request.request_id
+                  if self._prefilling else None)
         self._advance_prefill()
+        prefill_s = (time.perf_counter() - t0
+                     if self._timeline is not None else 0.0)
         if not self._occupants:
             if self._prefilling:      # prefill-only tick is still work
                 self.steps += 1
+                self._note_tick(prefill_s, 0.0, pf_req)
                 return True
             self._flush_decode_span()  # idle boundary: publish the tail
             self._work.clear()
@@ -401,9 +421,32 @@ class ServeEngine:
             if self.queue_depth():
                 self._work.set()
             return False
+        self._last_decode_dur = 0.0
         self._decode_tick()
         self.steps += 1
+        self._note_tick(prefill_s, self._last_decode_dur, pf_req)
         return True
+
+    def _note_tick(self, prefill_s: float, decode_s: float,
+                   pf_req: str | None) -> None:
+        """One serving timeline record per tick (opt-in): the prefill vs
+        decode wall split the SLO percentiles cannot show — a decode tick
+        stretched by interleaved prefill chunks is visible here per tick,
+        per mid-prefill request. Also advances an attached profiler's
+        bounded capture window."""
+        if self._profiler is not None:
+            self._profiler.observe_step(self.steps)
+        if self._timeline is None:
+            return
+        rec = {"tick": self.steps, "prefill_s": round(prefill_s, 6),
+               "decode_s": round(decode_s, 6),
+               "active": len(self._occupants),
+               "queue_depth": len(self._queue)}
+        if self.prefill_chunks_last_tick:
+            rec["prefill_chunks"] = self.prefill_chunks_last_tick
+        if pf_req is not None:
+            rec["prefilling_request"] = pf_req
+        self._timeline.write(rec)
 
     # -- admission: the ONE prefill path for both caches -------------------
 
@@ -606,7 +649,8 @@ class ServeEngine:
         self.slots.update_from_step(out)
         next_token = np.asarray(out["token"])       # blocks: real tick time
         new_keys = np.asarray(out["keys"])
-        self._note_decode_tick(t_wall, time.perf_counter() - t0, n_active)
+        self._last_decode_dur = time.perf_counter() - t0
+        self._note_decode_tick(t_wall, self._last_decode_dur, n_active)
 
         for slot in list(self._occupants):
             r = self._occupants[slot]
@@ -660,6 +704,15 @@ class ServeEngine:
             slot=slot)
         self.stats.record(ttft=ttft, tpot=tpot, queue_wait=queue_wait,
                           tokens=r.emitted)
+        if self._slo is not None and error is None:
+            breaches = self._slo.breaches(ttft, tpot, queue_wait)
+            if breaches:
+                self.stats.record_slo_breach()
+                if self._profiler is not None:
+                    # bounded capture of the ticks around the breach —
+                    # retention-capped, never raises into the loop
+                    self._profiler.trigger(
+                        f"serve_slo_{breaches[0]}", step=self.steps)
         self._occupants.pop(slot, None)
         self.slots.release(slot)
         r.handle._finish(error)
@@ -706,6 +759,8 @@ class ServeEngine:
         later submits raise EngineShutdown instead of queueing into a dead
         engine."""
         self._flush_decode_span()
+        if self._profiler is not None:
+            self._profiler.close()  # finalize an open capture window
         err = EngineShutdown("serve engine shut down")
         with self._lock:
             self._closed = True

@@ -23,7 +23,32 @@ Definitions (docs/SERVING.md "SLO metrics"):
 from __future__ import annotations
 
 import collections
+import dataclasses
 import threading
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOThresholds:
+    """Per-request SLO limits the engine checks at completion time (None =
+    unchecked). A breach bumps the `slo_breaches` counter and — when a
+    TriggeredProfiler is attached (utils/profiler.py) — fires a bounded
+    trace capture of the ticks around the slow request
+    (docs/OBSERVABILITY.md "Triggered capture")."""
+
+    ttft_s: float | None = None
+    tpot_s: float | None = None
+    queue_wait_s: float | None = None
+
+    def breaches(self, ttft: float, tpot: float | None,
+                 queue_wait: float) -> list[str]:
+        out = []
+        if self.ttft_s is not None and ttft > self.ttft_s:
+            out.append("ttft")
+        if self.tpot_s is not None and tpot is not None and tpot > self.tpot_s:
+            out.append("tpot")
+        if self.queue_wait_s is not None and queue_wait > self.queue_wait_s:
+            out.append("queue_wait")
+        return out
 
 
 def percentile(values, q: float) -> float | None:
@@ -66,6 +91,7 @@ class SLOStats:
         self.rejected = 0
         self.failed = 0
         self.page_refused = 0
+        self.slo_breaches = 0
         self.tokens_generated = 0
 
     def record(self, ttft: float, tpot: float | None, queue_wait: float,
@@ -89,6 +115,13 @@ class SLOStats:
         with self._lock:
             self.failed += 1
 
+    def record_slo_breach(self) -> None:
+        """A completed request blew a configured SLOThresholds limit —
+        counted next to the percentiles so an operator sees breach RATE,
+        not just the rolling tail."""
+        with self._lock:
+            self.slo_breaches += 1
+
     def record_page_refused(self) -> None:
         """Rejected because the free-page pool could not cover the
         request's worst-case demand (paged cache only; counted within
@@ -105,6 +138,7 @@ class SLOStats:
                 "requests_rejected": self.rejected,
                 "requests_failed": self.failed,
                 "requests_page_refused": self.page_refused,
+                "slo_breaches": self.slo_breaches,
                 "tokens_generated": self.tokens_generated,
             }
             out.update(percentiles_ms(list(self.ttft), "ttft"))

@@ -56,7 +56,14 @@ from llama_pipeline_parallel_tpu.parallel.distributed import (
     set_barrier_timeout,
 )
 from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
-from llama_pipeline_parallel_tpu.utils import faults, numerics, trace
+from llama_pipeline_parallel_tpu.utils import (
+    faults,
+    numerics,
+    perf,
+    profiler as profiler_mod,
+    timeline as timeline_mod,
+    trace,
+)
 from llama_pipeline_parallel_tpu.utils.config import instantiate
 from llama_pipeline_parallel_tpu.utils.logging import get_logger
 from llama_pipeline_parallel_tpu.utils.metrics import (
@@ -208,6 +215,56 @@ def _offload_static(pcfg: "pl.PipelineConfig", mb_rows: int,
             # 6 decimals: KiB resolution, so tiny-model smoke runs still
             # report a nonzero residency
             "offload_stash_resident_gib": round(resident / (1 << 30), 6)}
+
+
+def _make_observatory(cfg: dict, pcfg: "pl.PipelineConfig", output_dir: str
+                      ) -> tuple:
+    """The schedule observatory's run-scoped pieces
+    (docs/OBSERVABILITY.md): the measured timeline driver (`timeline.*`
+    config block — opt-in, blocks on every step's loss when on) and the
+    triggered profiler (`profiler.*` block — bounded capture windows on
+    at_step / step-time z-score / numerics-anomaly triggers). One
+    construction for both optimizer paths."""
+    tcfg = timeline_mod.TimelineConfig.from_cfg(cfg.get("timeline"))
+    step_tl = None
+    if tcfg.enabled:
+        step_tl = timeline_mod.StepTimeline(
+            pcfg, output_dir, write=jax.process_index() == 0,
+            window=tcfg.window)
+        logger.info(
+            "timeline enabled: per-segment boundary marks compiled into "
+            "the step, every step's loss fetch blocks (timeline.jsonl; "
+            "docs/OBSERVABILITY.md 'Timelines')")
+    pcap = profiler_mod.CaptureConfig.from_cfg(cfg.get("profiler"))
+    prof = (profiler_mod.TriggeredProfiler(pcap, output_dir)
+            if pcap is not None and jax.process_index() == 0 else None)
+    return step_tl, prof
+
+
+def _write_perf_rows(cfg: dict, pcfg: "pl.PipelineConfig", output_dir: str,
+                     step_tl) -> None:
+    """Close the run into the perf ledger (utils/perf.py): the analytic
+    bubble next to its timeline-measured counterpart plus the rolling
+    step-time percentiles — the trainer's contribution to the
+    model-vs-measured calibration table tools/perf_report.py renders."""
+    if step_tl is None or jax.process_index() != 0:
+        return
+    rows = [perf.make_row(
+        "bubble_fraction", model=pl.bubble_fraction(pcfg),
+        measured=step_tl.measured_bubble_median(), source="train",
+        run=output_dir, schedule=pcfg.schedule,
+        virtual_stages=pcfg.virtual_stages)]
+    sc = step_tl.scalars()
+    if "step_time_p50" in sc:
+        rows.append(perf.make_row(
+            "step_time_s", measured=sc["step_time_p50"], unit="s",
+            source="train", run=output_dir, p95=sc.get("step_time_p95")))
+    peak_bytes, src = trace.device_peak_bytes()
+    if peak_bytes is not None and src == "device":
+        rows.append(perf.make_row(
+            "peak_gib", measured=peak_bytes / (1 << 30), unit="GiB",
+            source="train", run=output_dir))
+    perf.append_rows(os.path.join(output_dir, "perf.jsonl"), rows)
 
 
 def _schedule_static_scalars(pcfg: "pl.PipelineConfig") -> dict:
@@ -813,9 +870,14 @@ def _run_training(cfg: dict) -> dict:
     # the step when the active fault plan carries such a rule — steady-state
     # runs keep the two-argument signature (no extra per-step H2D).
     poison_on = faults.has_rule("step", "grad_nonfinite")
+    step_tl, prof = _make_observatory(cfg, pcfg, output_dir)
     step_fn = ts.make_train_step(mesh, model_cfg, pcfg, tx, schedule,
                                  stacked_template, attn_fn=attn_fn,
-                                 collect_stats=ncfg.enabled, poison=poison_on)
+                                 collect_stats=ncfg.enabled, poison=poison_on,
+                                 # gpipe has no segments: marks stay out and
+                                 # the timeline degrades to step-wall records
+                                 timeline=step_tl is not None
+                                 and step_tl.segmented)
 
     # ---- loop -------------------------------------------------------------
     state_box = [state]
@@ -874,7 +936,8 @@ def _run_training(cfg: dict) -> dict:
             static_scalars={**_schedule_static_scalars(pcfg), **off_static},
             monitor=monitor, data_start=data_start,
             health_static={**_schedule_health_static(pcfg, topology),
-                           **off_static})
+                           **off_static},
+            step_timeline=step_tl, profiler=prof)
     except BaseException:
         # join the in-flight commit, but never let ITS failure replace the
         # training exception that actually killed the run
@@ -885,6 +948,7 @@ def _run_training(cfg: dict) -> dict:
                              "unwinding a training error")
         raise
     mgr.finalize()  # surface any async-commit failure on the clean path
+    _write_perf_rows(cfg, pcfg, output_dir, step_tl)
     return _summarize(final_loss, preempted_at, end_step, steps_per_epoch,
                       output_dir)
 
@@ -1157,7 +1221,7 @@ def _host_scalars(collator, loader) -> Any:
 def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                 do_step, do_save, do_eval=None, extra_scalars=None,
                 static_scalars=None, monitor=None, data_start=(0, 0),
-                health_static=None) -> tuple:
+                health_static=None, step_timeline=None, profiler=None) -> tuple:
     """The shared step/log/save/profile loop for both optimizer paths.
 
     `do_step(batch, step, fault=None) -> (loss_scalar, scalars_thunk)`; the
@@ -1175,6 +1239,13 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
     `data_start` ((epoch, batch), from _resume_data_position) opens the
     repeating loader at the O(1) resume position; `health_static`
     (optional dict, e.g. the run topology) rides on every health.json write.
+    `step_timeline` (timeline.StepTimeline, optional — the schedule
+    observatory) wraps every step with the collector window, BLOCKS on each
+    step's loss (the marks-to-steps barrier), and contributes
+    `bubble_fraction_measured` / `step_time_p50/p95` to the metrics line +
+    health.json. `profiler` (profiler.TriggeredProfiler, optional) gets
+    each iteration's host wall for the step-time z-score trigger, the
+    numerics-anomaly span stream, and a close() on every exit path.
     """
     output_dir = cfg["output_dir"]
     # Scalars are replicated across processes: process 0 writes for the pod
@@ -1196,6 +1267,9 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
     # is the init bucket; record it retroactively as a span so the offline
     # goodput report's bucket sum matches wall-clock.
     rec = trace.recorder()
+    if profiler is not None:
+        # numerics-anomaly spans become bounded captures (utils/profiler.py)
+        rec.add_listener(profiler.on_span)
     rec.emit("init", rec.configured_at, time.time() - rec.configured_at)
     # Resume carries the previous incarnation's cumulative buckets forward:
     # goodput stays a whole-run number, and the wall time the preemption
@@ -1206,10 +1280,19 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                            already_elapsed=init_secs)
     clock.add("init", init_secs)
     rec.add_listener(clock.on_span)
+    # LIVE health.json contributions: the numerics monitor's fields plus the
+    # timeline's rolling bubble_fraction_measured / step_time percentiles —
+    # a ChainMap so both owners keep mutating their own dict between writes
+    import collections as _collections
+
+    live_fields = [m for m in (
+        monitor.health_fields if monitor is not None else None,
+        step_timeline.health_fields if step_timeline is not None else None)
+        if m is not None]
     heartbeat = (trace.Heartbeat(output_dir, clock,
                                  interval=cfg.get("health_interval", 10.0),
-                                 extra=monitor.health_fields
-                                 if monitor is not None else None,
+                                 extra=(_collections.ChainMap(*live_fields)
+                                        if live_fields else None),
                                  static=health_static)
                  if jax.process_index() == 0 else None)
     peak_bytes, peak_src = trace.device_peak_bytes()
@@ -1263,6 +1346,11 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
 
     try:
         for step in range(resume_step, end_step):
+            # per-iteration host wall, taken BEFORE the fault hook so a
+            # `slow` chaos rule at the step site lands in the measured wall
+            # the profiler's z-score trigger watches (docs/OBSERVABILITY.md
+            # "Triggered capture")
+            iter_t0 = time.perf_counter()
             # chaos hook: a `die`/`stall` rule at a chosen step simulates
             # preemption or a hung pod at an exact, reproducible point; a
             # `grad_nonfinite` verdict rides into do_step to poison the
@@ -1294,6 +1382,8 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                 trace_active = True
             with trace.span("data_wait", step=step):
                 batch = next(it)
+            if step_timeline is not None:
+                step_timeline.pre_step(step + 1)
             try:
                 if step == resume_step:
                     # First step: trace+XLA-compile happen synchronously
@@ -1316,6 +1406,17 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                 completed = step + 1
                 raise
             completed = step + 1
+            if step_timeline is not None:
+                # block-on-boundary: the marks-to-steps barrier (and the
+                # measured step wall) — the timeline mode's documented cost
+                step_timeline.post_step(step + 1, loss)
+            if profiler is not None:
+                # compile step excluded from the z-score baseline (a 100x
+                # wall would deflate every later z); it still advances an
+                # open capture window
+                profiler.observe_step(
+                    step + 1, None if step == resume_step
+                    else time.perf_counter() - iter_t0)
             if heartbeat is not None:
                 heartbeat.beat(step + 1)
             if trace_active and (step + 1 >= profile_window[1] or step + 1 == end_step):
@@ -1349,6 +1450,8 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                                       **(static_scalars or {}),
                                       **(monitor.scalars() if monitor is not None
                                          else {}),
+                                      **(step_timeline.scalars()
+                                         if step_timeline is not None else {}),
                                       "goodput": round(clock.goodput(), 4),
                                       "step_time": round(step_dur, 4),
                                       "device_peak_bytes": peak_bytes})
@@ -1389,6 +1492,11 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
         if trace_active:  # preemption break / exception inside the window
             jax.profiler.stop_trace()
             logger.info("profiler trace (early exit) written to %s/profile", output_dir)
+        if profiler is not None:
+            rec.remove_listener(profiler.on_span)
+            profiler.close()  # a capture window open at exit is finalized
+        if step_timeline is not None:
+            step_timeline.close()
         if monitor is not None:
             monitor.close()
         loader.close_ledger()  # repeated in-process runs must not leak fds
@@ -1545,9 +1653,11 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
                                model_cfg=model_cfg,
                                packed=_packing_factor(cfg) > 1,
                                micro_batch=cfg.get("per_device_train_batch_size", 1))
+    step_tl, prof = _make_observatory(cfg, pcfg, output_dir)
     loss_and_grad = pl.make_pipeline_loss_and_grad(
         mesh, model_cfg, pcfg, stacked_template, attn_fn=attn_fn,
-        collect_stats=ncfg.enabled)
+        collect_stats=ncfg.enabled,
+        timeline_segments=step_tl is not None and step_tl.segmented)
     from jax.sharding import NamedSharding, PartitionSpec
 
     def _replicate_stats(stats):
@@ -1623,8 +1733,14 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
         # + H2D upload instead of a serial update-all-then-upload-all
         # (a nonfinite global norm skips the masters update, see
         # HostOffloadAdamW.skip_nonfinite)
+        t_opt = time.perf_counter()
         device_params_box[0] = to_replicated(
             host.update_and_refresh(grads, model_cfg.dtype))
+        if step_tl is not None:
+            # the host optimizer is outside the compiled pipeline, so its
+            # phase is measured here instead of by a boundary mark
+            step_tl.add_host_segment("optimizer_host",
+                                     time.perf_counter() - t_opt)
         if monitor is not None:
             monitor.observe(step, loss, host.last_grad_norm, stats)
         return loss, lambda: {"lr": host.last_lr,
@@ -1663,6 +1779,8 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
         static_scalars={**_schedule_static_scalars(pcfg), **off_static},
         monitor=monitor, data_start=data_start,
         health_static={**_schedule_health_static(pcfg, topology),
-                       **off_static})
+                       **off_static},
+        step_timeline=step_tl, profiler=prof)
+    _write_perf_rows(cfg, pcfg, output_dir, step_tl)
     return _summarize(final_loss, preempted_at, end_step, len(loader),
                       output_dir)

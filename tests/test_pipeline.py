@@ -80,7 +80,11 @@ def test_pp1_matches_plain_forward(cfg, params, devices):
 
 
 @pytest.mark.parametrize("pp,dp,microbatches", [
-    (4, 1, 4), (2, 2, 3),
+    # pp4 pure rep slow-marked (PR 14 rebalance): (2,2,3) composes dp +
+    # odd M over the same interpreter, and the zb1/interleaved grids keep
+    # their own pp reps fast
+    pytest.param(4, 1, 4, marks=pytest.mark.slow),
+    (2, 2, 3),
     pytest.param(4, 1, 6, marks=pytest.mark.slow),
     pytest.param(4, 2, 4, marks=pytest.mark.slow)])
 def test_pp_matches_reference(cfg, params, devices, pp, dp, microbatches):

@@ -37,7 +37,10 @@ def run_tp(params, batch, cfg, pp, dp, tp, microbatches):
 
 
 @pytest.mark.parametrize("pp,dp,tp,mb", [
-    (1, 1, 2, 2), (1, 1, 4, 2),
+    (1, 1, 2, 2),
+    # tp=4 widens the shard, it does not change the collective structure
+    # tp=2 already pins (PR 14 rebalance)
+    pytest.param(1, 1, 4, 2, marks=pytest.mark.slow),
     pytest.param(2, 1, 2, 2, marks=pytest.mark.slow),
     pytest.param(2, 2, 2, 2, marks=pytest.mark.slow)])
 def test_tp_matches_reference(cfg, params, devices, pp, dp, tp, mb):

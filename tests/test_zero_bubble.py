@@ -454,6 +454,10 @@ def test_trainer_accepts_zb1_virtual_stages(cfg):
 # Full-trainer plumbing (the CI schedule-parity gate's artifact producer)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # PR 14 rebalance: the Observatory suite's timeline e2e
+# drives run_training over the SAME zb1-v2 interpreter path every fast run
+# (tests/test_timeline.py::test_trainer_timeline_e2e, with metrics/health
+# assertions on top); the zb1 parity reps above stay fast
 def test_trainer_zb1_end_to_end(tmp_path, devices):
     """run_training with schedule: zb1 + virtual_stages: 2 — the metrics
     line carries schedule/bubble_fraction/wgrad_queue_depth, health.json

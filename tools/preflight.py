@@ -1271,6 +1271,49 @@ def _run_all(patterns: list[str], hbm_gb: float, overrides: list[str]) -> None:
         sys.exit(1)
 
 
+CALIBRATION_KEYS = {"mfu": "mfu", "host_bw_gibps": "host_bw_gibps",
+                    "ici_bw_gibps": "ici_bw_gibps"}
+
+
+def load_calibration(path: str) -> dict:
+    """Read a perf_report --emit-calibration constants file. Raises
+    SystemExit with a readable message on unreadable/garbage input — a
+    user pointing --calibration at the wrong file must get a verdict, not
+    a traceback; a file with no usable keys returns {} (the CLI defaults
+    then stand)."""
+    import json
+
+    try:
+        with open(path) as f:
+            calib = json.load(f)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"--calibration {path} is not readable JSON: {e}")
+    if not isinstance(calib, dict):
+        raise SystemExit(f"--calibration {path} is not a JSON object "
+                         f"(got {type(calib).__name__})")
+    out = {}
+    for key in CALIBRATION_KEYS:
+        try:
+            v = float(calib[key])
+        except (KeyError, TypeError, ValueError):
+            continue
+        if v > 0:
+            out[key] = v
+    return out
+
+
+def apply_calibration(args, path: str) -> dict:
+    """Override the CLI model constants with the file's measured values
+    (only the keys it carries). Returns what was applied — the
+    measured-re-selection loop: bench/train measure, perf_report distills,
+    --select re-ranks from the measurements."""
+    applied = load_calibration(path)
+    for key, attr in CALIBRATION_KEYS.items():
+        if key in applied:
+            setattr(args, attr, applied[key])
+    return applied
+
+
 def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", default=None,
@@ -1340,6 +1383,13 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--chip-flops", type=float, default=None,
                    help="chip peak FLOP/s for the compute model (default: "
                         "detect, else 197e12)")
+    p.add_argument("--calibration", default=None, metavar="JSON",
+                   help="measured constants file from tools/perf_report.py "
+                        "--emit-calibration: keys present there (mfu, "
+                        "host_bw_gibps, ici_bw_gibps) override the CLI "
+                        "assumptions above, so --select re-ranks the "
+                        "frontier from MEASURED bandwidth/MFU instead of "
+                        "guesses (docs/PREFLIGHT.md 'Calibration')")
     p.add_argument("overrides", nargs="*", help="key=value config overrides")
     args, unknown = p.parse_known_args(argv)
     bad = [u for u in unknown if not (u.startswith("--") and "=" in u)]
@@ -1352,6 +1402,15 @@ def main(argv: list[str] | None = None) -> None:
 
         print(json.dumps(calibrate(), indent=2))
         return
+    if args.calibration:
+        applied = apply_calibration(args, args.calibration)
+        if applied:
+            print("calibration: " + ", ".join(
+                f"{k}={v}" for k, v in applied.items())
+                + f" (measured — {args.calibration})")
+        else:
+            print(f"calibration: {args.calibration} carries no usable keys; "
+                  f"keeping the CLI assumptions")
     if (args.emit_ladder or args.layout_devices) and not args.select:
         p.error("--emit-ladder/--layout-devices extend --select (the layout "
                 "lane calibrates against the compiled peak --select anchors "

@@ -101,6 +101,20 @@ def main(argv: list[str] | None = None) -> int:
                    help="after SIGTERM/SIGINT: seconds to finish in-flight "
                         "and queued requests before failing the remainder "
                         "(keep below the supervisor's --grace-s)")
+    p.add_argument("--timeline", action="store_true",
+                   help="write a per-tick timeline.jsonl (prefill-chunk vs "
+                        "decode-step wall split — the serving half of the "
+                        "schedule observatory, docs/OBSERVABILITY.md "
+                        "'Timelines')")
+    p.add_argument("--slo_ttft_ms", type=float, default=None,
+                   help="TTFT SLO in ms: breaches count on the metrics "
+                        "line and fire a bounded profiler capture under "
+                        "<output_dir>/captures (docs/OBSERVABILITY.md "
+                        "'Triggered capture')")
+    p.add_argument("--slo_queue_wait_ms", type=float, default=None,
+                   help="queue-wait SLO in ms (same breach handling)")
+    p.add_argument("--capture_max", type=int, default=3,
+                   help="retention cap for SLO-breach profiler captures")
     args = p.parse_args(argv)
 
     if args.platform:
@@ -137,7 +151,31 @@ def main(argv: list[str] | None = None) -> int:
         num_pages=args.num_pages, kv_quant=args.kv_quant,
         prefill_chunk_tokens=args.prefill_chunk_tokens)
     writer = MetricsWriter(args.output_dir)
-    engine = ServeEngine(params, cfg, serve_cfg, metrics_writer=writer)
+    tl_writer = None
+    if args.timeline:
+        from llama_pipeline_parallel_tpu.utils.timeline import TimelineWriter
+
+        tl_writer = TimelineWriter(
+            os.path.join(args.output_dir, "timeline.jsonl"))
+    slo = prof = None
+    if args.slo_ttft_ms is not None or args.slo_queue_wait_ms is not None:
+        from llama_pipeline_parallel_tpu.serve.telemetry import SLOThresholds
+        from llama_pipeline_parallel_tpu.utils.profiler import (
+            CaptureConfig,
+            TriggeredProfiler,
+        )
+
+        slo = SLOThresholds(
+            ttft_s=(args.slo_ttft_ms / 1000.0
+                    if args.slo_ttft_ms is not None else None),
+            queue_wait_s=(args.slo_queue_wait_ms / 1000.0
+                          if args.slo_queue_wait_ms is not None else None))
+        prof = TriggeredProfiler(
+            CaptureConfig(zscore=0.0, max_captures=args.capture_max,
+                          window_steps=8),
+            args.output_dir)
+    engine = ServeEngine(params, cfg, serve_cfg, metrics_writer=writer,
+                         timeline=tl_writer, profiler=prof, slo=slo)
 
     server = make_server(engine, args.host, args.port)
     port = server.server_address[1]
@@ -212,9 +250,23 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         server.shutdown()
         engine.shutdown()
+        snap = engine.metrics_snapshot()
         if engine.stats.completed:
-            writer.log(engine.stats.completed, engine.metrics_snapshot())
+            writer.log(engine.stats.completed, snap)
+            # the serve loop's perf-ledger contribution: measured SLO
+            # latencies (no analytic halves yet — the pairing the serving
+            # cost models of a future PR will fill in)
+            from llama_pipeline_parallel_tpu.utils import perf
+
+            perf.append_rows(
+                os.path.join(args.output_dir, "perf.jsonl"),
+                [perf.make_row(f"serve:{k}", measured=snap[k], unit="ms",
+                               source="serve", run=args.output_dir)
+                 for k in ("ttft_p50_ms", "ttft_p95_ms", "tpot_p50_ms",
+                           "queue_wait_p95_ms") if k in snap])
         writer.close()
+        if tl_writer is not None:
+            tl_writer.close()
         hb.stop()
     return 0
 
