@@ -26,6 +26,14 @@ import collections
 import dataclasses
 import threading
 
+# the cumulative serving counters every offline report surfaces next to
+# the SLO percentiles (requests_* / slo_breaches / tokens_generated) —
+# ONE spelling shared by tools/serving_report.py and
+# tools/goodput_report.py so the two reports cannot drift
+SERVE_COUNTER_KEYS = ("requests_completed", "requests_rejected",
+                      "requests_failed", "requests_page_refused",
+                      "slo_breaches", "tokens_generated")
+
 
 @dataclasses.dataclass(frozen=True)
 class SLOThresholds:

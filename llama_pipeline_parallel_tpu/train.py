@@ -236,8 +236,13 @@ def _make_observatory(cfg: dict, pcfg: "pl.PipelineConfig", output_dir: str
             "the step, every step's loss fetch blocks (timeline.jsonl; "
             "docs/OBSERVABILITY.md 'Timelines')")
     pcap = profiler_mod.CaptureConfig.from_cfg(cfg.get("profiler"))
+    if pcap is None:
+        # no `profiler:` block arms ONLY the fleet trigger-file surface
+        # (docs/OBSERVABILITY.md "Fleet"): z-score/at_step captures stay
+        # off, but a fleet alert can still reach in for a bounded trace
+        pcap = profiler_mod.CaptureConfig(zscore=0.0, on_anomaly=False)
     prof = (profiler_mod.TriggeredProfiler(pcap, output_dir)
-            if pcap is not None and jax.process_index() == 0 else None)
+            if jax.process_index() == 0 else None)
     return step_tl, prof
 
 

@@ -13,7 +13,14 @@ run; this layer captures the step you could not have picked — fired by:
   `numerics_anomaly` spans; `TriggeredProfiler.on_span` subscribes to the
   span stream and converts them into captures);
 - serving SLO breaches (serve/engine.py calls `trigger()` when a
-  completed request blows a configured threshold).
+  completed request blows a configured threshold);
+- fleet alerts (docs/OBSERVABILITY.md "Fleet"): a firing fleet-level
+  alert (tools/fleetd.py) drops a `capture.trigger` file into this
+  process's output dir; `observe_step` polls for it (rate-limited by
+  `profiler.trigger_poll_s`), consumes it, and starts a capture — a
+  cross-PROCESS symptom produces a bounded process-level trace. A
+  trigger dropped while the process was dead fires on the first step
+  after relaunch.
 
 Every capture is a bounded window: `profiler.window_steps` observe() calls
 (train steps or serve ticks) after which the trace stops, written under
@@ -29,6 +36,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import json
 import os
 import re
 import time
@@ -36,12 +44,14 @@ from typing import Any
 
 import numpy as np
 
+from llama_pipeline_parallel_tpu.utils.fleet import CAPTURE_TRIGGER_NAME
 from llama_pipeline_parallel_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
 PROFILER_KEYS = {"at_step", "window_steps", "max_captures", "zscore",
-                 "zscore_window", "zscore_min_history"}
+                 "zscore_window", "zscore_min_history", "trigger_poll_s",
+                 "on_anomaly"}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,6 +66,8 @@ class CaptureConfig:
     zscore: float = 4.0         # 0 disables the outlier trigger
     zscore_window: int = 32     # rolling step-time window
     zscore_min_history: int = 8  # steps before the trigger can arm
+    trigger_poll_s: float = 1.0  # capture.trigger poll cadence (fleet)
+    on_anomaly: bool = True     # numerics_anomaly spans start captures
 
     @classmethod
     def from_cfg(cls, node: Any) -> "CaptureConfig | None":
@@ -77,7 +89,9 @@ class CaptureConfig:
                   max_captures=int(node.get("max_captures", 3)),
                   zscore=float(node.get("zscore", 4.0)),
                   zscore_window=int(node.get("zscore_window", 32)),
-                  zscore_min_history=int(node.get("zscore_min_history", 8)))
+                  zscore_min_history=int(node.get("zscore_min_history", 8)),
+                  trigger_poll_s=float(node.get("trigger_poll_s", 1.0)),
+                  on_anomaly=bool(node.get("on_anomaly", True)))
         if cfg.window_steps < 1:
             raise ValueError("profiler.window_steps must be >= 1")
         if cfg.max_captures < 1:
@@ -106,6 +120,11 @@ class TriggeredProfiler:
         self._remaining = 0
         self._pending_at = set(cfg.at_step)
         self.captures_taken = 0
+        # fleet cross-process trigger (utils/fleet.py drops the file); the
+        # first poll is due immediately — a trigger left while this process
+        # was dead must fire on the first post-relaunch step
+        self._trigger_path = os.path.join(output_dir, CAPTURE_TRIGGER_NAME)
+        self._next_trigger_poll = 0.0
 
     # -- the three trigger surfaces ---------------------------------------
 
@@ -118,6 +137,7 @@ class TriggeredProfiler:
             self._remaining -= 1
             if self._remaining <= 0:
                 self._stop()
+        self.poll_fleet_trigger(step)
         # at_step semantics are "at or as soon after as possible": a
         # configured step that lands inside an active window (or was
         # skipped while one ran) fires at the first free boundary instead
@@ -142,11 +162,39 @@ class TriggeredProfiler:
                     return  # the outlier stays out of the baseline
         self._walls.append(wall_s)
 
+    def poll_fleet_trigger(self, step: int | None = None) -> bool:
+        """Consume a fleet-dropped `capture.trigger` in the output dir and
+        start a capture for it. Rate-limited (`trigger_poll_s`): steps/
+        ticks can run at token rate and a stat per tick would be pure
+        overhead. While a capture is already active the file is left in
+        place — it fires at the next free boundary instead of vanishing
+        into the busy window. Returns True when a capture started."""
+        now = time.monotonic()
+        if now < self._next_trigger_poll:
+            return False
+        self._next_trigger_poll = now + max(self.cfg.trigger_poll_s, 0.0)
+        if self._active_dir is not None \
+                or not os.path.exists(self._trigger_path):
+            return False
+        try:
+            with open(self._trigger_path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            payload = {}
+        reason = str((payload or {}).get("alert") or "fleet")
+        # consume BEFORE triggering: a retention-capped drop must not
+        # leave the file re-firing every poll forever
+        try:
+            os.unlink(self._trigger_path)
+        except OSError:
+            pass
+        return self.trigger(f"fleet_{reason}", step=step)
+
     def on_span(self, rec: dict) -> None:
         """Span-stream listener (utils/trace.SpanRecorder.add_listener):
         the numerics observatory's anomaly spans become captures with no
         coupling between the two modules."""
-        if rec.get("name") == "numerics_anomaly":
+        if self.cfg.on_anomaly and rec.get("name") == "numerics_anomaly":
             self.trigger("numerics_anomaly", step=rec.get("step"))
 
     def trigger(self, reason: str, step: int | None = None) -> bool:

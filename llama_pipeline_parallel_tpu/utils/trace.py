@@ -317,9 +317,13 @@ class Heartbeat:
 
     def __init__(self, output_dir: str, clock: RunClock | None = None,
                  interval: float = 10.0, min_write_interval: float = 1.0,
-                 extra: dict | None = None, static: dict | None = None):
+                 extra: dict | None = None, static: dict | None = None,
+                 filename: str = "health.json"):
+        # `filename`: the supervisor heartbeats the SAME output dir as the
+        # child it watches (supervisor_health.json), so watchdog staleness
+        # is itself observable without the two writers sharing one file
         os.makedirs(output_dir, exist_ok=True)
-        self.path = os.path.join(output_dir, "health.json")
+        self.path = os.path.join(output_dir, filename)
         self._clock = clock
         self._interval = interval
         self._min_write = min_write_interval

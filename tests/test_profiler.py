@@ -52,9 +52,40 @@ def test_capture_config_parse():
         CaptureConfig.from_cfg({"max_captures": 0})
 
 
+def test_at_step_deferred_semantics(tmp_path, monkeypatch):
+    """at_step 4 lands INSIDE the step-3 capture window: it must fire at
+    the first free boundary after the window closes, not silently drop.
+    Capture start/stop are stubbed — the state machine is the contract
+    here; the real-trace rep below stays in the round gate."""
+    prof = TriggeredProfiler(
+        CaptureConfig(at_step=(3, 4), window_steps=2, zscore=0.0),
+        str(tmp_path))
+    started = []
+
+    def fake_start(path, reason):
+        prof._active_dir = path
+        prof._remaining = prof.cfg.window_steps
+        prof.captures_taken += 1
+        started.append((reason, path))
+        return True
+
+    monkeypatch.setattr(prof, "_start", fake_start)
+    monkeypatch.setattr(prof, "_stop",
+                        lambda: setattr(prof, "_active_dir", None))
+    for step in range(1, 9):
+        prof.observe_step(step, 0.01)
+    assert not prof.capturing  # windows closed
+    assert prof.captures_taken == 2
+    # step 3 fired at 3; step 4's landed inside that window and fired at
+    # the first free boundary (step 5), never dropped
+    assert [r for r, _ in started] == ["at_step", "at_step"]
+    assert "step3" in started[0][1] and "step5" in started[1][1]
+
+
+@pytest.mark.slow  # two real jax trace captures (~20 s); the deferral
+# state machine is pinned fast above, and capture-dir/trace readability
+# fast by the zscore test — this rep funds the fleet fast lanes
 def test_at_step_trigger_bounded_window(tmp_path):
-    # at_step 4 lands INSIDE the step-3 capture window: it must fire at
-    # the first free boundary after the window closes, not silently drop
     prof = TriggeredProfiler(
         CaptureConfig(at_step=(3, 4), window_steps=2, zscore=0.0),
         str(tmp_path))

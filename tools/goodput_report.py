@@ -25,25 +25,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def load_jsonl(path: str) -> list[dict]:
-    """Parse a jsonl stream, skipping torn lines: a crashed writer (the
-    whole reason this tool exists) can leave a truncated tail in any of the
-    run artifacts, and the report must degrade, not traceback."""
-    if not os.path.exists(path):
-        return []
-    out, skipped = [], 0
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                out.append(json.loads(line))
-            except ValueError:
-                skipped += 1
-    if skipped:
-        print(f"warning: skipped {skipped} unparseable line(s) in {path} "
-              f"(torn write from a crashed run?)", file=sys.stderr)
-    return out
+    """THE tolerant jsonl reader — `perf.read_jsonl`, spelled once for the
+    whole repo: a crashed writer (the whole reason this tool exists) can
+    leave a truncated tail or garbage line in any run artifact, and every
+    reader must degrade to whatever parses, never traceback."""
+    from llama_pipeline_parallel_tpu.utils.perf import read_jsonl
+
+    return read_jsonl(path)
 
 
 def wall_window(spans: list[dict]) -> tuple[float, float]:
@@ -208,6 +196,55 @@ def incarnation_summary(output_dir: str) -> dict | None:
     }
 
 
+def supervisor_summary(output_dir: str) -> dict | None:
+    """Roll-up of the watchdog's OWN heartbeat (supervisor_health.json,
+    tools/supervisor.py), or None when the run is unsupervised — so the
+    report labels the directory's supervisor distinctly instead of
+    treating every health file as the trainer's."""
+    import time
+
+    path = os.path.join(output_dir, "supervisor_health.json")
+    try:
+        with open(path) as f:
+            health = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(health, dict):
+        return None
+    age = None
+    t = _num(health.get("time"))
+    if t is not None:
+        age = round(time.time() - t, 1)
+    return {"pid": health.get("pid"),
+            "heartbeat_age_s": age,
+            "restarts": health.get("restarts"),
+            "consecutive_failures": health.get("consecutive_failures"),
+            "last_outcome": health.get("last_outcome"),
+            "child_pid": health.get("child_pid"),
+            "layout": health.get("layout")}
+
+
+# the p95-ish latency + capacity gauges this report shows NEXT to the
+# shared counter set (telemetry.SERVE_COUNTER_KEYS, the one spelling)
+_SERVE_GAUGE_KEYS = ("ttft_p95_ms", "tpot_p50_ms", "queue_wait_p95_ms",
+                     "pages_used", "pages_free", "pages_reserved",
+                     "prefilling", "prefill_chunks_total",
+                     "prefill_tokens_total")
+
+
+def serve_counter_summary(metrics: list[dict]) -> dict | None:
+    """Last serving metrics line's counter/gauge picture, or None for a
+    run that never served."""
+    from llama_pipeline_parallel_tpu.serve.telemetry import SERVE_COUNTER_KEYS
+
+    serving = [m for m in metrics if isinstance(m, dict) and m.get("serving")]
+    if not serving:
+        return None
+    last = serving[-1]
+    return {k: last[k] for k in SERVE_COUNTER_KEYS + _SERVE_GAUGE_KEYS
+            if k in last}
+
+
 def numerics_summary(output_dir: str, top: int = 5) -> dict | None:
     """Roll-up of the numerics observatory's stream (numerics.jsonl, one row
     per step — utils/numerics.py), or None when the run had numerics off.
@@ -252,6 +289,10 @@ def build_report(output_dir: str, top: int = 5) -> dict:
         "health_status": health_status,
         "cumulative_goodput": _num(health.get("goodput")),
         "last_step": health.get("last_step"),
+        # serve replicas heartbeat a role; a trainer's health has none
+        "role": health.get("role") or "trainer",
+        "serve_counters": serve_counter_summary(metrics),
+        "supervisor": supervisor_summary(output_dir),
         "incarnations": incarnation_summary(output_dir),
         "numerics": numerics_summary(output_dir, top),
         "slowest_windows": slowest_windows(spans, metrics, top),
@@ -268,12 +309,23 @@ def build_report(output_dir: str, top: int = 5) -> dict:
 
 def print_report(rep: dict) -> None:
     wall = rep["wall_seconds"]
-    print(f"run: {rep['output_dir']}  ({rep['spans']} spans, "
-          f"{rep['metrics_lines']} metrics lines, last step "
-          f"{rep['last_step']})")
+    print(f"run: {rep['output_dir']}  (role {rep.get('role', 'trainer')}, "
+          f"{rep['spans']} spans, {rep['metrics_lines']} metrics lines, "
+          f"last step {rep['last_step']})")
     if rep.get("health_status") != "ok":
         print(f"  (health.json {rep['health_status']} — cumulative goodput / "
               f"last-step fields degraded)")
+
+    sup = rep.get("supervisor")
+    if sup:
+        loop = (f", {sup['consecutive_failures']} consecutive failure(s)"
+                if sup.get("consecutive_failures") else "")
+        age = (f", heartbeat {sup['heartbeat_age_s']:.0f}s old"
+               if sup.get("heartbeat_age_s") is not None else "")
+        print(f"\n== supervisor (watchdog heartbeat) ==\n"
+              f"  pid {sup.get('pid')}, {sup.get('restarts') or 0} "
+              f"restart(s){loop}, last outcome "
+              f"{sup.get('last_outcome')}{age}")
 
     inc = rep.get("incarnations")
     if inc:
@@ -309,6 +361,11 @@ def print_report(rep: dict) -> None:
         if num["anomaly_count"]:
             print("  (details: python tools/numerics_report.py "
                   f"{rep['output_dir']})")
+
+    serve = rep.get("serve_counters")
+    if serve:
+        print("\n== serving counters (last metrics line) ==")
+        print("  " + " ".join(f"{k}={serve[k]}" for k in serve))
 
     print(f"\n== time buckets: {wall:.2f} s wall ==")
     for name, secs in sorted(rep["buckets"].items(), key=lambda kv: -kv[1]):

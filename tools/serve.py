@@ -96,6 +96,9 @@ def main(argv: list[str] | None = None) -> int:
                         "(paged only; 0 = whole-prompt admissions)")
     p.add_argument("--metrics_every", type=int, default=16,
                    help="completed requests per serving metrics line")
+    p.add_argument("--health_interval", type=float, default=10.0,
+                   help="health.json heartbeat cadence in seconds (size "
+                        "fleet heartbeat_stale_s alerts above this)")
     p.add_argument("--idle_poll_s", type=float, default=0.02)
     p.add_argument("--drain_s", type=float, default=15.0,
                    help="after SIGTERM/SIGINT: seconds to finish in-flight "
@@ -157,23 +160,28 @@ def main(argv: list[str] | None = None) -> int:
 
         tl_writer = TimelineWriter(
             os.path.join(args.output_dir, "timeline.jsonl"))
-    slo = prof = None
+    from llama_pipeline_parallel_tpu.utils.profiler import (
+        CaptureConfig,
+        TriggeredProfiler,
+    )
+
+    # the profiler is ALWAYS armed: without SLO thresholds it captures
+    # nothing on its own, but its capture.trigger poll is what lets a
+    # fleet-level alert (tools/fleetd.py) reach into this replica for a
+    # bounded trace (docs/OBSERVABILITY.md "Fleet")
+    prof = TriggeredProfiler(
+        CaptureConfig(zscore=0.0, max_captures=args.capture_max,
+                      window_steps=8),
+        args.output_dir)
+    slo = None
     if args.slo_ttft_ms is not None or args.slo_queue_wait_ms is not None:
         from llama_pipeline_parallel_tpu.serve.telemetry import SLOThresholds
-        from llama_pipeline_parallel_tpu.utils.profiler import (
-            CaptureConfig,
-            TriggeredProfiler,
-        )
 
         slo = SLOThresholds(
             ttft_s=(args.slo_ttft_ms / 1000.0
                     if args.slo_ttft_ms is not None else None),
             queue_wait_s=(args.slo_queue_wait_ms / 1000.0
                           if args.slo_queue_wait_ms is not None else None))
-        prof = TriggeredProfiler(
-            CaptureConfig(zscore=0.0, max_captures=args.capture_max,
-                          window_steps=8),
-            args.output_dir)
     engine = ServeEngine(params, cfg, serve_cfg, metrics_writer=writer,
                          timeline=tl_writer, profiler=prof, slo=slo)
 
@@ -199,7 +207,7 @@ def main(argv: list[str] | None = None) -> int:
             kv_quant=serve_cfg.kv_quant,
             prefill_chunk_tokens=serve_cfg.prefill_chunk_tokens)
     hb = trace.Heartbeat(
-        args.output_dir, clock,
+        args.output_dir, clock, interval=args.health_interval,
         static={"role": "serve", "port": port,
                 "checkpoint_step": step,
                 "serve_config": hb_serve_cfg})
@@ -231,6 +239,11 @@ def main(argv: list[str] | None = None) -> int:
                 if step_delay:
                     time.sleep(step_delay)
             else:
+                # an idle replica must still honor a fleet capture trigger
+                # AND advance an open capture window (the engine only does
+                # either inside work ticks — without this, an idle-started
+                # capture would trace nothing, unbounded, until traffic)
+                prof.observe_step(engine.steps)
                 engine._work.wait(args.idle_poll_s)
         # graceful drain: no new connections, finish what's in flight —
         # the documented stop contract; whatever outlives the window is

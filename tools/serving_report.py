@@ -24,25 +24,17 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from llama_pipeline_parallel_tpu.serve.telemetry import (  # noqa: E402
+    SERVE_COUNTER_KEYS,
     percentiles_ms,
 )
 
 
 def load_jsonl(path: str) -> list[dict]:
-    """Parseable dict rows only; a torn tail or garbage line is skipped."""
-    rows = []
-    try:
-        with open(path) as f:
-            for line in f:
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                if isinstance(rec, dict):
-                    rows.append(rec)
-    except OSError:
-        pass
-    return rows
+    """Parseable dict rows only — `perf.read_jsonl`, the one spelling of
+    the tolerant reader (a torn tail or garbage line is skipped)."""
+    from llama_pipeline_parallel_tpu.utils.perf import read_jsonl
+
+    return read_jsonl(path)
 
 
 def build_report(output_dir: str) -> dict:
@@ -116,19 +108,23 @@ def main(argv: list[str] | None = None) -> int:
     last = rep["last_metrics"]
     if last:
         print("\n== last serving metrics line ==")
+        # the shared counter set (telemetry.SERVE_COUNTER_KEYS — the one
+        # spelling goodput_report renders too) plus this report's
+        # occupancy extras
         occupancy = {k: last.get(k) for k in
-                     ("requests_completed", "requests_rejected",
-                      "active_slots", "queue_depth", "slot_allocations",
-                      "decode_steps") if k in last}
+                     SERVE_COUNTER_KEYS
+                     + ("active_slots", "queue_depth", "slot_allocations",
+                        "decode_steps") if k in last}
         print("  " + " ".join(f"{k}={v}" for k, v in occupancy.items()))
         if last.get("kv_cache") == "paged":
             # the paged-capacity picture next to the SLOs: pool occupancy,
             # worst-case reservations, the admission-refusal counter, and
             # the prefill-chunk cadence (docs/SERVING.md "Paged KV cache")
+            # requests_page_refused moved up into the counter line above
             pages = {k: last.get(k) for k in
                      ("pages_used", "pages_reserved", "pages_total",
-                      "page_size", "kv_quant", "page_allocations",
-                      "requests_page_refused") if k in last}
+                      "page_size", "kv_quant", "page_allocations")
+                     if k in last}
             print("  page pool: " + " ".join(f"{k}={v}"
                                              for k, v in pages.items()))
             chunks = {k: last.get(k) for k in

@@ -58,6 +58,15 @@ print(jax.device_count())"` in a fresh process. When no rung fits, the
 supervisor aborts with exit 4 — running a layout the hardware cannot hold
 would just crash-loop.
 
+Fleet observatory (docs/OBSERVABILITY.md "Fleet"): the supervisor writes
+its OWN heartbeat to `<output_dir>/supervisor_health.json` (role=
+supervisor, restart count, consecutive-failure crash-loop state, current
+child pid) — watchdog staleness is as observable as the child's. With
+--fleet-root, the supervisor and every child (re)launch are registered in
+`<fleet-root>/registry.jsonl` (role/replica/output_dir/pid/incarnation/
+layout), the discovery contract tools/fleetd.py aggregates a whole pod
+from.
+
 Exit codes: 0 child completed; 2 restart budget exhausted; 3 crash loop;
 4 no ladder rung fits the available devices; when the supervisor itself
 is stopped, the child's own exit code (a signal death maps to the shell
@@ -154,6 +163,13 @@ class SupervisorConfig:
     poll_s: float = 1.0
     ladder: list | None = None      # LayoutRungs, best-first (None = inelastic)
     probe_cmd: str | None = None    # shell command printing the device count
+    # fleet observatory (docs/OBSERVABILITY.md "Fleet"): every launch is
+    # registered in <fleet_root>/registry.jsonl so tools/fleetd.py can
+    # discover and tail this member; role/replica label the registration
+    # (role is otherwise learned from the child's own health.json)
+    fleet_root: str | None = None
+    role: str | None = None
+    replica: str | None = None
 
 
 class Supervisor:
@@ -175,6 +191,67 @@ class Supervisor:
         # ledger so a resize across a SUPERVISOR restart (new process, same
         # output_dir) is still recorded as resized
         self._last_layout: str | None = self._last_ledger_layout()
+        # the watchdog's OWN heartbeat (supervisor_health.json, started in
+        # run()): watchdog staleness must be as observable as the child's —
+        # a fleet whose supervisor died silently cannot restart anything
+        self._hb = None
+        self._hb_state: dict[str, Any] = {
+            "incarnation": None, "child_pid": None, "restarts": 0,
+            "consecutive_failures": 0, "last_outcome": None, "layout": None}
+
+    def _heartbeat_start(self) -> None:
+        try:
+            from llama_pipeline_parallel_tpu.utils import fleet, trace
+        except Exception as e:  # the watchdog must run even half-installed
+            print(f"[supervisor] own heartbeat unavailable ({e!r})",
+                  flush=True)
+            return
+        try:
+            self._hb = trace.Heartbeat(
+                self.cfg.output_dir,
+                interval=min(10.0, max(self.cfg.poll_s, 0.5)),
+                static={"role": "supervisor", "pid": os.getpid(),
+                        "watched_dir": os.path.abspath(self.cfg.output_dir),
+                        "max_restarts": self.cfg.max_restarts},
+                extra=self._hb_state,
+                filename=fleet.SUPERVISOR_HEALTH_NAME)
+        except OSError as e:
+            print(f"[supervisor] own heartbeat unavailable ({e!r})",
+                  flush=True)
+        try:
+            if self.cfg.fleet_root:
+                fleet.register_member(
+                    self.cfg.fleet_root, output_dir=self.cfg.output_dir,
+                    role="supervisor", pid=os.getpid(),
+                    replica=self.cfg.replica,
+                    health_file=fleet.SUPERVISOR_HEALTH_NAME)
+        except Exception as e:
+            # registration is telemetry; a full fleet disk must not stop
+            # the watchdog from launching anything (_register_incarnation's
+            # rule, applied to the supervisor's own row too)
+            print(f"[supervisor] fleet registration failed: {e!r}",
+                  flush=True)
+
+    def _register_incarnation(self, incarnation: int, pid: int,
+                              layout: dict | None) -> None:
+        """Fleet registry contract: one row per LAUNCH, so the aggregator
+        sees a fresh pid/incarnation the moment the child exists (and its
+        registration vouches liveness until the first health.json write)."""
+        if not self.cfg.fleet_root:
+            return
+        try:
+            from llama_pipeline_parallel_tpu.utils import fleet
+
+            fleet.register_member(
+                self.cfg.fleet_root, output_dir=self.cfg.output_dir,
+                role=self.cfg.role, replica=self.cfg.replica,
+                pid=pid, incarnation=incarnation,
+                supervisor_pid=os.getpid(), **(layout or {}))
+        except Exception as e:
+            # registration is telemetry; a full fleet disk must not stop
+            # the restart loop
+            print(f"[supervisor] fleet registration failed: {e!r}",
+                  flush=True)
 
     def _last_ledger_layout(self) -> str | None:
         try:
@@ -300,6 +377,14 @@ class Supervisor:
               flush=True)
         child = subprocess.Popen(cmd, env=self.env)
         self._child = child
+        self._register_incarnation(incarnation, child.pid, layout)
+        self._hb_state.update(incarnation=incarnation, child_pid=child.pid,
+                              layout=(layout or {}).get("layout"))
+        if self._hb is not None:
+            try:
+                self._hb.write()
+            except OSError:  # full disk must not orphan the fresh child
+                pass
         outcome = "clean"
         while True:
             rc = child.poll()
@@ -366,6 +451,7 @@ class Supervisor:
                 prev_handlers[sig] = signal.signal(sig, self._forward_signal)
             except ValueError:  # not the main thread (in-process tests)
                 pass
+        self._heartbeat_start()
         try:
             failures: list[dict] = []  # consecutive non-clean incarnations
             for incarnation in range(self.cfg.max_restarts + 1):
@@ -391,6 +477,11 @@ class Supervisor:
                               "resized": resized}
                     self._last_layout = rung.label()
                 rec = self._run_once(incarnation, cmd=cmd, layout=layout)
+                self._hb_state.update(
+                    last_outcome=rec["outcome"], restarts=incarnation,
+                    consecutive_failures=(
+                        0 if rec["outcome"] in ("clean", "supervisor_stopped")
+                        else self._hb_state["consecutive_failures"] + 1))
                 if rec["outcome"] == "clean":
                     return 0
                 if rec["outcome"] == "supervisor_stopped":
@@ -415,6 +506,11 @@ class Supervisor:
                   f"({self.cfg.max_restarts} restarts)", flush=True)
             return 2
         finally:
+            if self._hb is not None:
+                try:  # final state: last outcome + restart count
+                    self._hb.stop()
+                except OSError:
+                    pass  # heartbeat is telemetry; handlers must restore
             for sig, handler in prev_handlers.items():
                 signal.signal(sig, handler)
 
@@ -449,6 +545,17 @@ def main(argv: list[str] | None = None) -> int:
                    help="shell command printing the available device count "
                         "(default: this interpreter importing jax in a "
                         "fresh process); only used with --layout-ladder")
+    p.add_argument("--fleet-root", default=None,
+                   help="fleet observatory home: register this member and "
+                        "every (re)launch in <fleet-root>/registry.jsonl "
+                        "for tools/fleetd.py (docs/OBSERVABILITY.md "
+                        "'Fleet')")
+    p.add_argument("--role", default=None,
+                   help="registry role label (trainer/serve); default: "
+                        "learned from the child's health.json")
+    p.add_argument("--replica", default=None,
+                   help="registry replica id; default: the output dir's "
+                        "basename")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="the training command, after `--`")
     args = p.parse_args(argv)
@@ -460,7 +567,8 @@ def main(argv: list[str] | None = None) -> int:
         hang_timeout_s=args.hang_timeout_s, grace_s=args.grace_s,
         crash_loop_threshold=args.crash_loop_threshold,
         crash_loop_window_s=args.crash_loop_window_s, poll_s=args.poll_s,
-        ladder=parse_ladder(args.layout_ladder), probe_cmd=args.probe_cmd))
+        ladder=parse_ladder(args.layout_ladder), probe_cmd=args.probe_cmd,
+        fleet_root=args.fleet_root, role=args.role, replica=args.replica))
     return sup.run()
 
 
