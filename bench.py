@@ -43,8 +43,9 @@ def _parse_args(argv=None):
     """CLI surface (env vars keep working; flags win where both exist):
 
     --full-trajectory  the one-shot runbook: force every `extra:*` row
-                       family ON (sched-*, layout-*, offload-*, kernel-*,
-                       serve-*) regardless of the BENCH_* env toggles and
+                       family ON (sched-*, layout-*, offload-*, mem-*,
+                       kernel-*, serve-*) regardless of the BENCH_* env
+                       toggles and
                        write every row into the perf ledger — the "first
                        reachable-TPU run records everything in one pass"
                        mode, runnable end-to-end on CPU today.
@@ -67,7 +68,8 @@ def _parse_args(argv=None):
     args, _ = p.parse_known_args(argv)
     if args.full_trajectory:
         for var in ("BENCH_EXTRAS", "BENCH_SCHEDULES", "BENCH_LAYOUT",
-                    "BENCH_OFFLOAD", "BENCH_KERNELS", "BENCH_SERVING"):
+                    "BENCH_OFFLOAD", "BENCH_MEM", "BENCH_KERNELS",
+                    "BENCH_SERVING"):
             os.environ[var] = "1"
         if not args.perf_ledger:
             args.perf_ledger = "perf.jsonl"
@@ -242,8 +244,10 @@ def main() -> None:
             "mfu": round(mfu, 4),
             "step_time_ms": round(1000 * best["dt"], 1),
             "best_config": best_name,
+            # untimed gauge rows (extra:mem-pagepool) carry dt=0: no tok_s
             "all_configs": {k: {"ms": round(1000 * r["dt"], 1),
-                                "tok_s": round(tps_of(r), 1),
+                                "tok_s": round(tps_of(r), 1) if r["dt"]
+                                else None,
                                 **r.get("detail", {})}
                             for k, r in results.items()},
             # round-1 emitted a flat name->ms map under this key; keep it so
@@ -751,6 +755,86 @@ def main() -> None:
                                 round(transfer_s / dts[False], 3)}}
             except Exception as e:
                 print(f"bench offload rows failed: {e!r}", file=sys.stderr,
+                      flush=True)
+
+        # Memory observatory rows (BENCH_MEM=0 skips): the compiled
+        # memory_analysis() peak (the byte model's measured counterpart —
+        # utils/memwatch.py) next to the LIVE device peak after a real
+        # step, plus a page-pool fragmentation point. The mem-peak pair is
+        # what perf_report distills into the `mem_scale` calibration
+        # constant preflight --select re-ranks with; on CPU the live half
+        # is host RSS-ish and the row is tagged with its backend so
+        # derive_calibration excludes it (cpu rows never calibrate).
+        if os.environ.get("BENCH_MEM", "1") != "0" and row_budget.allow("mem"):
+            try:
+                from llama_pipeline_parallel_tpu.utils import memwatch
+
+                n_dev = jax.device_count()
+                m_m = int(os.environ.get("BENCH_SCHED_MICROBATCHES", "8"))
+                pp_m = next((p for p in (4, 2, 1)
+                             if p <= n_dev and m_m % p == 0
+                             and cfg.num_hidden_layers % p == 0), 1)
+                mem_mesh = make_mesh(MeshConfig(pp=pp_m))
+                man_m = StageManifest.for_config(cfg, pp_m)
+                stacked_m = pl.stack_stages(canonical, man_m)
+                mbatch = make_batch(m_m)
+                pcfg_m = pl.PipelineConfig(num_stages=pp_m,
+                                           num_microbatches=m_m)
+                fn = jax.jit(pl.make_pipeline_loss_and_grad(
+                    mem_mesh, cfg, pcfg_m, stacked_m))
+                info = memwatch.compiled_memory(
+                    fn.lower(stacked_m, mbatch).compile(), top_buffers=4,
+                    label="bench_step")
+                t0 = time.perf_counter()
+                last = float(fn(stacked_m, mbatch)[0])
+                dt_m = time.perf_counter() - t0
+                if not np.isfinite(last):
+                    raise ValueError(f"non-finite loss {last}")
+                live = memwatch.live_sample()
+                live_peak = live.get("device_peak_bytes")
+                gib = 1 << 30
+                results["extra:mem-peak"] = {
+                    "dt": dt_m, "tokens_per_step": m_m * seq,
+                    "headline": False, "detail": {
+                        "backend": jax.devices()[0].platform,
+                        "pp": pp_m,
+                        "compiled_peak_gib":
+                            round(info["peak_bytes"] / gib, 3)
+                            if info else None,
+                        "temp_gib": round(info["temp_bytes"] / gib, 3)
+                        if info else None,
+                        "live_peak_gib": round(live_peak / gib, 3)
+                        if live_peak else None,
+                        "live_source": "device" if live_peak else "none",
+                        "top_buffers": (info or {}).get("top_buffers",
+                                                        [])[:4]}}
+
+                # page-pool fragmentation point: reserve worst-case demand,
+                # back only the prompt — the reserved-vs-allocated gap the
+                # serving gauges publish per tick, measured here once
+                from llama_pipeline_parallel_tpu.serve import pages as pages_mod
+
+                kvp = pages_mod.PagedKVCache(cfg, max_slots=4, max_len=64,
+                                             page_size=16, num_pages=32)
+                demand = kvp.demand_pages(32, 16)
+                kvp.reserve(demand)
+                slot = kvp.acquire("bench-mem", demand)
+                kvp.ensure_capacity(slot, 32)
+                g = kvp.fragmentation_gauges()
+                results["extra:mem-pagepool"] = {
+                    "dt": 0.0, "tokens_per_step": 0, "headline": False,
+                    "detail": {
+                        "backend": jax.devices()[0].platform,
+                        "pool_gib": round(pages_mod.paged_pool_bytes(
+                            cfg, 32, 16) / gib, 4),
+                        "reserved_gap_gib":
+                            round(g["reserved_gap_bytes"] / gib, 6),
+                        **{k: g[k] for k in ("pages_free", "pages_used",
+                                             "pages_reserved",
+                                             "reserved_unbacked",
+                                             "fragmentation")}}}
+            except Exception as e:
+                print(f"bench memory rows failed: {e!r}", file=sys.stderr,
                       flush=True)
 
         # Pallas kernel rows (BENCH_KERNELS=0 skips): the fused CE head and

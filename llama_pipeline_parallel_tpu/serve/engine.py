@@ -442,6 +442,13 @@ class ServeEngine:
                "decode_s": round(decode_s, 6),
                "active": len(self._occupants),
                "queue_depth": len(self._queue)}
+        if self._paged:
+            # page-pool occupancy PER TICK: the fragmentation timeline —
+            # how the reserved-vs-allocated gap moves as requests admit,
+            # decode, and release (the snapshot gauges only show now)
+            rec["pages_used"] = self.slots.pages_used
+            rec["pages_reserved"] = self.slots.pages_reserved
+            rec["fragmentation"] = round(self.slots.fragmentation, 4)
         if self.prefill_chunks_last_tick:
             rec["prefill_chunks"] = self.prefill_chunks_last_tick
         if pf_req is not None:
@@ -739,6 +746,14 @@ class ServeEngine:
             snap["pages_used"] = self.slots.pages_used
             snap["pages_free"] = self.slots.pages_free
             snap["pages_reserved"] = self.slots.pages_reserved
+            # the reservation-vs-allocation gap: HBM promised to worst-case
+            # demand that has not materialized as written tokens (pages.py
+            # fragmentation docstring) — /healthz serves this verbatim and
+            # the fleet aggregates it across pods
+            snap["reserved_unbacked"] = self.slots.reserved_unbacked
+            snap["page_fragmentation"] = round(self.slots.fragmentation, 4)
+            snap["reserved_gap_bytes"] = (self.slots.reserved_unbacked
+                                          * self.slots.page_bytes())
             snap["page_allocations"] = self.slots.page_allocations
             snap["prefilling"] = len(self._prefilling)
             snap["prefill_chunks_last_tick"] = self.prefill_chunks_last_tick

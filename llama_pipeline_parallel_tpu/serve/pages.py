@@ -133,6 +133,44 @@ class PagedKVCache:
     def pages_reserved(self) -> int:
         return self._queued_reserved + self._slot_reserved_total
 
+    @property
+    def reserved_unbacked(self) -> int:
+        """Pages promised (admission control) but not yet physically
+        allocated — the reservation-vs-allocation gap. Every backed page
+        counts against some slot's reservation, so this is never negative."""
+        return max(self.pages_reserved - self.pages_used, 0)
+
+    @property
+    def fragmentation(self) -> float:
+        """Fraction of the promised capacity that is NOT backed by tokens:
+        0.0 = every reserved page holds written KV (dense-equivalent),
+        approaching 1.0 = the pool is committed to worst-case demand that
+        never materialized — exactly the over-reservation the paged cache
+        exists to avoid paying in HBM, surfaced as a number so the
+        operator can size num_pages against measured (not worst-case)
+        demand (docs/OBSERVABILITY.md "Memory")."""
+        reserved = self.pages_reserved
+        return self.reserved_unbacked / reserved if reserved else 0.0
+
+    def page_bytes(self) -> int:
+        """Resident HBM of ONE pool page (int8 scales included) — what a
+        unit of the reservation gap costs if it were backed."""
+        one = paged_pool_bytes(self.cfg, 1, self.page_size, self.quant)
+        zero = paged_pool_bytes(self.cfg, 0, self.page_size, self.quant)
+        return one - zero  # difference cancels the garbage-page constant
+
+    def fragmentation_gauges(self) -> dict:
+        """The page-pool occupancy snapshot `/healthz` and the serve
+        timeline publish each tick."""
+        return {
+            "pages_free": self.pages_free,
+            "pages_used": self.pages_used,
+            "pages_reserved": self.pages_reserved,
+            "reserved_unbacked": self.reserved_unbacked,
+            "fragmentation": round(self.fragmentation, 4),
+            "reserved_gap_bytes": self.reserved_unbacked * self.page_bytes(),
+        }
+
     def demand_pages(self, bucket: int, max_new_tokens: int) -> int:
         return page_demand(bucket, max_new_tokens, self.page_size)
 

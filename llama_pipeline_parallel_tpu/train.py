@@ -58,6 +58,7 @@ from llama_pipeline_parallel_tpu.parallel.distributed import (
 from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
 from llama_pipeline_parallel_tpu.utils import (
     faults,
+    memwatch as memwatch_mod,
     numerics,
     perf,
     profiler as profiler_mod,
@@ -217,14 +218,17 @@ def _offload_static(pcfg: "pl.PipelineConfig", mb_rows: int,
             "offload_stash_resident_gib": round(resident / (1 << 30), 6)}
 
 
-def _make_observatory(cfg: dict, pcfg: "pl.PipelineConfig", output_dir: str
-                      ) -> tuple:
-    """The schedule observatory's run-scoped pieces
-    (docs/OBSERVABILITY.md): the measured timeline driver (`timeline.*`
-    config block — opt-in, blocks on every step's loss when on) and the
-    triggered profiler (`profiler.*` block — bounded capture windows on
-    at_step / step-time z-score / numerics-anomaly triggers). One
-    construction for both optimizer paths."""
+def _make_observatory(cfg: dict, pcfg: "pl.PipelineConfig", output_dir: str,
+                      stash_bytes: int | None = None) -> tuple:
+    """The observatory's run-scoped pieces (docs/OBSERVABILITY.md): the
+    measured timeline driver (`timeline.*` config block — opt-in, blocks
+    on every step's loss when on), the triggered profiler (`profiler.*`
+    block — bounded capture windows on at_step / step-time z-score /
+    numerics-anomaly triggers), and the memory watch (`memory.*` block —
+    opt-in compiled-analysis capture + live per-step sampler; OFF
+    compiles and samples nothing). One construction for both optimizer
+    paths; `stash_bytes` is the host-stash resident estimate the
+    sampler's rows carry next to the device/host polls."""
     tcfg = timeline_mod.TimelineConfig.from_cfg(cfg.get("timeline"))
     step_tl = None
     if tcfg.enabled:
@@ -243,32 +247,49 @@ def _make_observatory(cfg: dict, pcfg: "pl.PipelineConfig", output_dir: str
         pcap = profiler_mod.CaptureConfig(zscore=0.0, on_anomaly=False)
     prof = (profiler_mod.TriggeredProfiler(pcap, output_dir)
             if jax.process_index() == 0 else None)
-    return step_tl, prof
+    mcfg = memwatch_mod.MemoryConfig.from_cfg(cfg.get("memory"))
+    mem_watch = None
+    if mcfg.enabled:
+        mem_watch = memwatch_mod.MemoryWatch(
+            output_dir, every=mcfg.every, top_buffers=mcfg.top_buffers,
+            write=jax.process_index() == 0,
+            stash_bytes=stash_bytes or None)
+        logger.info(
+            "memory watch enabled: compiled memory_analysis captured per "
+            "program, live sampler every %d step(s) (memory.jsonl; "
+            "docs/OBSERVABILITY.md 'Memory')", mcfg.every)
+    return step_tl, prof, mem_watch
 
 
 def _write_perf_rows(cfg: dict, pcfg: "pl.PipelineConfig", output_dir: str,
-                     step_tl) -> None:
+                     step_tl, mem_watch=None) -> None:
     """Close the run into the perf ledger (utils/perf.py): the analytic
     bubble next to its timeline-measured counterpart plus the rolling
-    step-time percentiles — the trainer's contribution to the
+    step-time percentiles, and — with the memory watch on — the
+    compiled-vs-live memory rows (`mem_peak_gib`,
+    `compiled_peak_gib:<label>`) — the trainer's contribution to the
     model-vs-measured calibration table tools/perf_report.py renders."""
-    if step_tl is None or jax.process_index() != 0:
+    if (step_tl is None and mem_watch is None) or jax.process_index() != 0:
         return
-    rows = [perf.make_row(
-        "bubble_fraction", model=pl.bubble_fraction(pcfg),
-        measured=step_tl.measured_bubble_median(), source="train",
-        run=output_dir, schedule=pcfg.schedule,
-        virtual_stages=pcfg.virtual_stages)]
-    sc = step_tl.scalars()
-    if "step_time_p50" in sc:
+    rows = []
+    if step_tl is not None:
         rows.append(perf.make_row(
-            "step_time_s", measured=sc["step_time_p50"], unit="s",
-            source="train", run=output_dir, p95=sc.get("step_time_p95")))
-    peak_bytes, src = trace.device_peak_bytes()
-    if peak_bytes is not None and src == "device":
-        rows.append(perf.make_row(
-            "peak_gib", measured=peak_bytes / (1 << 30), unit="GiB",
-            source="train", run=output_dir))
+            "bubble_fraction", model=pl.bubble_fraction(pcfg),
+            measured=step_tl.measured_bubble_median(), source="train",
+            run=output_dir, schedule=pcfg.schedule,
+            virtual_stages=pcfg.virtual_stages))
+        sc = step_tl.scalars()
+        if "step_time_p50" in sc:
+            rows.append(perf.make_row(
+                "step_time_s", measured=sc["step_time_p50"], unit="s",
+                source="train", run=output_dir, p95=sc.get("step_time_p95")))
+        peak_bytes, src = trace.device_peak_bytes()
+        if peak_bytes is not None and src == "device":
+            rows.append(perf.make_row(
+                "peak_gib", measured=peak_bytes / (1 << 30), unit="GiB",
+                source="train", run=output_dir))
+    if mem_watch is not None:
+        rows.extend(mem_watch.perf_rows(run=output_dir))
     perf.append_rows(os.path.join(output_dir, "perf.jsonl"), rows)
 
 
@@ -875,7 +896,11 @@ def _run_training(cfg: dict) -> dict:
     # the step when the active fault plan carries such a rule — steady-state
     # runs keep the two-argument signature (no extra per-step H2D).
     poison_on = faults.has_rule("step", "grad_nonfinite")
-    step_tl, prof = _make_observatory(cfg, pcfg, output_dir)
+    step_tl, prof, mem_watch = _make_observatory(
+        cfg, pcfg, output_dir,
+        stash_bytes=pl.host_stash_bytes(pcfg, *pl.stash_dims(
+            micro_batch, seq_length, mesh_cfg.sp, model_cfg.hidden_size,
+            model_cfg.dtype)))
     step_fn = ts.make_train_step(mesh, model_cfg, pcfg, tx, schedule,
                                  stacked_template, attn_fn=attn_fn,
                                  collect_stats=ncfg.enabled, poison=poison_on,
@@ -889,6 +914,19 @@ def _run_training(cfg: dict) -> dict:
 
     def do_step(batch, step, fault=None):
         gbatch = form_global_batch(mesh, batch)
+        if mem_watch is not None and "train_step" not in mem_watch.compiled:
+            # compile-time memory evidence (docs/OBSERVABILITY.md
+            # "Memory"): AOT lowering reads only avals — no execution, no
+            # donation — and the one extra compile is the watch's
+            # documented ON cost, landing in the first step's compile
+            # bucket. OFF never reaches this branch.
+            try:
+                args = ((state_box[0], gbatch, numerics.fault_stage(None))
+                        if poison_on else (state_box[0], gbatch))
+                mem_watch.note_compiled("train_step",
+                                        step_fn.lower(*args).compile())
+            except Exception as e:
+                logger.debug("compiled memory capture failed: %r", e)
         if poison_on:
             new_state, metrics = step_fn(state_box[0], gbatch,
                                          numerics.fault_stage(fault))
@@ -942,7 +980,7 @@ def _run_training(cfg: dict) -> dict:
             monitor=monitor, data_start=data_start,
             health_static={**_schedule_health_static(pcfg, topology),
                            **off_static},
-            step_timeline=step_tl, profiler=prof)
+            step_timeline=step_tl, profiler=prof, mem_watch=mem_watch)
     except BaseException:
         # join the in-flight commit, but never let ITS failure replace the
         # training exception that actually killed the run
@@ -953,7 +991,7 @@ def _run_training(cfg: dict) -> dict:
                              "unwinding a training error")
         raise
     mgr.finalize()  # surface any async-commit failure on the clean path
-    _write_perf_rows(cfg, pcfg, output_dir, step_tl)
+    _write_perf_rows(cfg, pcfg, output_dir, step_tl, mem_watch)
     return _summarize(final_loss, preempted_at, end_step, steps_per_epoch,
                       output_dir)
 
@@ -1226,7 +1264,8 @@ def _host_scalars(collator, loader) -> Any:
 def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                 do_step, do_save, do_eval=None, extra_scalars=None,
                 static_scalars=None, monitor=None, data_start=(0, 0),
-                health_static=None, step_timeline=None, profiler=None) -> tuple:
+                health_static=None, step_timeline=None, profiler=None,
+                mem_watch=None) -> tuple:
     """The shared step/log/save/profile loop for both optimizer paths.
 
     `do_step(batch, step, fault=None) -> (loss_scalar, scalars_thunk)`; the
@@ -1251,6 +1290,10 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
     health.json. `profiler` (profiler.TriggeredProfiler, optional) gets
     each iteration's host wall for the step-time z-score trigger, the
     numerics-anomaly span stream, and a close() on every exit path.
+    `mem_watch` (memwatch.MemoryWatch, optional — the memory
+    observatory) samples the live memory sources after every step and
+    feeds the OOM snapshot; the RESOURCE_EXHAUSTED handler below runs
+    with or without it (the snapshot degrades to the live poll alone).
     """
     output_dir = cfg["output_dir"]
     # Scalars are replicated across processes: process 0 writes for the pod
@@ -1361,6 +1404,14 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
             # `grad_nonfinite` verdict rides into do_step to poison the
             # jitted step's gradients (numerics observatory chaos input)
             fault_verdict = faults.fire("step", step=step)
+            if fault_verdict == "oom":
+                # synthetic allocation failure (chaos op `oom`): raised
+                # HERE, inside the loop's try, so it exercises the REAL
+                # RESOURCE_EXHAUSTED forensics path below — snapshot,
+                # supervisor `oom` outcome, fleet `oom_recent` alert
+                raise RuntimeError(
+                    f"RESOURCE_EXHAUSTED: Out of memory while running "
+                    f"step {step} (injected oom fault)")
             # The sync point must be polled EVERY step with the loop's step id
             # (the protocol computes max-step+1 as the one safe stop step for
             # the whole pod); it returns True on every process at that same
@@ -1415,6 +1466,10 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                 # block-on-boundary: the marks-to-steps barrier (and the
                 # measured step wall) — the timeline mode's documented cost
                 step_timeline.post_step(step + 1, loss)
+            if mem_watch is not None:
+                # host-side poll only (memory_stats + RSS) — never touches
+                # the dispatched computation; `memory.every` rate-limits it
+                mem_watch.sample(step + 1)
             if profiler is not None:
                 # compile step excluded from the z-score baseline (a 100x
                 # wall would deflate every later z); it still advances an
@@ -1493,6 +1548,23 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                      e.step, completed)
         do_save(completed, final=True)
         raise
+    except Exception as e:
+        if not memwatch_mod.is_resource_exhausted(e):
+            raise
+        # OOM forensics (docs/OBSERVABILITY.md "Memory"): the process is
+        # about to die — write the bounded snapshot FIRST (the supervisor
+        # labels the incarnation `oom` off its mtime, the fleet observatory
+        # alerts on it), then re-raise the original error. No final save:
+        # after a real allocation failure the device state is not
+        # trustworthy, and a hung save would turn a crisp abort into a hang.
+        logger.error("allocation failure at step %d; writing OOM snapshot "
+                     "to %s before exiting", completed,
+                     memwatch_mod.oom_dir(output_dir))
+        memwatch_mod.dump_oom_snapshot(output_dir, completed, e,
+                                       memwatch=mem_watch)
+        if profiler is not None:
+            profiler.trigger("oom", completed)
+        raise
     finally:
         if trace_active:  # preemption break / exception inside the window
             jax.profiler.stop_trace()
@@ -1502,6 +1574,8 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
             profiler.close()  # a capture window open at exit is finalized
         if step_timeline is not None:
             step_timeline.close()
+        if mem_watch is not None:
+            mem_watch.close()
         if monitor is not None:
             monitor.close()
         loader.close_ledger()  # repeated in-process runs must not leak fds
@@ -1658,7 +1732,11 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
                                model_cfg=model_cfg,
                                packed=_packing_factor(cfg) > 1,
                                micro_batch=cfg.get("per_device_train_batch_size", 1))
-    step_tl, prof = _make_observatory(cfg, pcfg, output_dir)
+    step_tl, prof, mem_watch = _make_observatory(
+        cfg, pcfg, output_dir,
+        stash_bytes=pl.host_stash_bytes(pcfg, *pl.stash_dims(
+            cfg.get("per_device_train_batch_size", 1), seq_length,
+            mesh.shape["sp"], model_cfg.hidden_size, model_cfg.dtype)))
     loss_and_grad = pl.make_pipeline_loss_and_grad(
         mesh, model_cfg, pcfg, stacked_template, attn_fn=attn_fn,
         collect_stats=ncfg.enabled,
@@ -1722,6 +1800,16 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
 
     def do_step(batch, step, fault=None):
         gbatch = form_global_batch(mesh, batch)
+        if mem_watch is not None and "loss_and_grad" not in mem_watch.compiled:
+            # the offload path's device program is loss+grad (the
+            # optimizer lives on the host): same one-shot AOT capture as
+            # the fused path's train_step
+            try:
+                mem_watch.note_compiled(
+                    "loss_and_grad",
+                    grad_fn.lower(device_params_box[0], gbatch).compile())
+            except Exception as e:
+                logger.debug("compiled memory capture failed: %r", e)
         stats = None
         if not ncfg.enabled:
             loss, grads = grad_fn(device_params_box[0], gbatch)
@@ -1785,7 +1873,7 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
         monitor=monitor, data_start=data_start,
         health_static={**_schedule_health_static(pcfg, topology),
                        **off_static},
-        step_timeline=step_tl, profiler=prof)
-    _write_perf_rows(cfg, pcfg, output_dir, step_tl)
+        step_timeline=step_tl, profiler=prof, mem_watch=mem_watch)
+    _write_perf_rows(cfg, pcfg, output_dir, step_tl, mem_watch)
     return _summarize(final_loss, preempted_at, end_step, len(loader),
                       output_dir)

@@ -58,6 +58,11 @@ Ops:
             were available — the chaos input for the elastic fallback
             ladder (docs/RESILIENCE.md "Elastic resume"). Extra field
             `devices` (default 0) is the REMAINING device count.
+  oom       (`step` site only) `fire()` returns "oom" and the trainer
+            raises a synthetic RESOURCE_EXHAUSTED through the real
+            allocation-failure handler — the chaos input for the memory
+            observatory's OOM forensics (snapshot to <output_dir>/oom/,
+            supervisor `oom` outcome, fleet `oom_recent` alert).
 
 Sites threaded through the codebase: `storage_write` (checkpoint file
 I/O), `ckpt_commit` (between array durability and the meta/tag write),
@@ -83,7 +88,7 @@ logger = get_logger(__name__)
 ENV_PLAN = "LPT_FAULT_PLAN"
 
 _OPS = ("error", "stall", "slow", "corrupt", "die", "grad_nonfinite",
-        "device_loss")
+        "device_loss", "oom")
 _SITES = ("storage_write", "ckpt_commit", "barrier", "data_read", "step",
           "device_probe")
 
@@ -214,6 +219,9 @@ class FaultInjector:
                 logger.warning("%s: simulating device loss (%d remaining)",
                                desc, rule.devices)
                 verdict = f"device_loss:{rule.devices}"
+            elif rule.op == "oom":
+                logger.warning("%s: simulating allocation failure", desc)
+                verdict = "oom"
             elif rule.op == "die":
                 # raw stderr write then a hard kill: the point is an unclean
                 # death (no atexit, no finally) — exactly what a preempted

@@ -48,6 +48,7 @@ import re
 import time
 from typing import Any
 
+from llama_pipeline_parallel_tpu.utils import memwatch
 from llama_pipeline_parallel_tpu.utils.logging import get_logger
 from llama_pipeline_parallel_tpu.utils.perf import read_jsonl
 
@@ -235,7 +236,8 @@ def latest_verified_step(checkpoint_root: str) -> int | None:
 # ---------------------------------------------------------------------------
 
 ALERT_KEYS = {"heartbeat_stale_s", "goodput_floor", "step_time_p95_s",
-              "ttft_p95_ms", "checkpoint_lag_steps", "nonfinite_steps"}
+              "ttft_p95_ms", "checkpoint_lag_steps", "nonfinite_steps",
+              "oom_recent"}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -253,6 +255,12 @@ class AlertRules:
       than this many steps behind the trainer's latest verified one.
     - nonfinite_steps: more than this many nonfinite training steps
       (0 = any nonfinite step alerts).
+    - oom_recent: fires while a member's newest `oom/` snapshot
+      (utils/memwatch.py forensics) postdates its latest registration —
+      memory pressure killed THIS incarnation. Threshold 0 = any recent
+      OOM alerts; the rule resolves deterministically when the
+      supervisor's relaunch re-registers the member (newer `ts` than
+      the snapshot).
     """
 
     heartbeat_stale_s: float | None = None
@@ -261,6 +269,7 @@ class AlertRules:
     ttft_p95_ms: float | None = None
     checkpoint_lag_steps: int | None = None
     nonfinite_steps: int | None = None
+    oom_recent: int | None = None
 
     @classmethod
     def from_cfg(cls, node: Any) -> "AlertRules":
@@ -276,7 +285,8 @@ class AlertRules:
         for key in ALERT_KEYS:
             if node.get(key) is not None:
                 kw[key] = (int(node[key]) if key in
-                           ("checkpoint_lag_steps", "nonfinite_steps")
+                           ("checkpoint_lag_steps", "nonfinite_steps",
+                            "oom_recent")
                            else float(node[key]))
         return cls(**kw)
 
@@ -317,6 +327,10 @@ class AlertRules:
         rule("nonfinite_steps", nf, self.nonfinite_steps,
              nf is not None and self.nonfinite_steps is not None
              and nf > self.nonfinite_steps)
+        oom = _num(member.get("oom_recent"))
+        rule("oom_recent", oom, self.oom_recent,
+             oom is not None and self.oom_recent is not None
+             and oom > self.oom_recent)
         return out
 
 
@@ -335,8 +349,10 @@ _SERVE_FIELDS = ("requests_completed", "requests_rejected", "requests_failed",
                  "ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms", "tpot_p50_ms",
                  "tpot_p95_ms", "queue_wait_p50_ms", "queue_wait_p95_ms",
                  "active_slots", "queue_depth", "pages_used", "pages_free",
-                 "pages_reserved", "pages_total", "page_allocations",
-                 "prefilling", "prefill_chunks_total", "prefill_tokens_total")
+                 "pages_reserved", "pages_total", "reserved_unbacked",
+                 "page_fragmentation", "reserved_gap_bytes",
+                 "page_allocations", "prefilling", "prefill_chunks_total",
+                 "prefill_tokens_total")
 _STEP_TIME_WINDOW = 64
 
 
@@ -521,6 +537,25 @@ class FleetAggregator:
             status["failed_incarnations"] = tail.inc_failed
             status["resizes"] = tail.resizes
             status["last_outcome"] = tail.inc_last.get("outcome")
+        if tail.resolved_role() != "supervisor":
+            # OOM forensics surface (utils/memwatch.py): snapshot count and
+            # the recency bit the oom_recent alert rule keys on. A snapshot
+            # newer than the latest registration means memory pressure
+            # killed THIS incarnation; a relaunch re-registers with a newer
+            # ts, flipping the bit back to 0 — the alert resolves on
+            # recovery, not by data going missing. Supervisor members share
+            # the child's output dir, so only the child publishes these.
+            try:
+                snaps = [f for f in os.listdir(memwatch.oom_dir(
+                    tail.output_dir)) if f.endswith(".json")]
+            except OSError:
+                snaps = []
+            if snaps:
+                status["oom_snapshots"] = len(snaps)
+            mtime = memwatch.latest_oom_mtime(tail.output_dir)
+            if mtime is not None or reg_ts:
+                status["oom_recent"] = int(mtime is not None and reg_ts > 0
+                                           and mtime > reg_ts)
         if tail.resolved_role() == "supervisor":
             for key in ("restarts", "consecutive_failures", "last_outcome",
                         "child_pid", "watched_dir"):

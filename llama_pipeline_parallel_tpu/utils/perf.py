@@ -187,6 +187,22 @@ def rows_from_bench_summary(summary: dict, run: str = "bench") -> list[dict]:
                 measured=detail["achieved_gibps"], unit="GiB/s",
                 source="bench", run=run,
                 bytes_model_gib=detail.get("bytes_model_gib")))
+        if name.startswith("extra:mem-peak"):
+            # the memory observatory's pairing: compiled memory_analysis
+            # peak (model half) vs the live device peak (measured half) —
+            # the row `derive_calibration` turns into `mem_scale`
+            rows.append(make_row(
+                "mem_peak_gib", model=detail.get("compiled_peak_gib"),
+                measured=detail.get("live_peak_gib"), unit="GiB",
+                source="bench", run=run, backend=detail.get("backend"),
+                temp_gib=detail.get("temp_gib")))
+        if name.startswith("extra:mem-pagepool"):
+            rows.append(make_row(
+                "page_fragmentation", measured=detail.get("fragmentation"),
+                unit="fraction", source="bench", run=run,
+                pages_reserved=detail.get("pages_reserved"),
+                pages_used=detail.get("pages_used"),
+                reserved_gap_gib=detail.get("reserved_gap_gib")))
     return rows
 
 
@@ -267,8 +283,9 @@ def summarize(rows: list[dict]) -> dict:
 def derive_calibration(rows: list[dict]) -> dict:
     """Measured constants for `preflight --select --calibration`: the
     knobs the CLI otherwise takes on faith (--mfu, --host-bw-gibps,
-    --ici-bw-gibps), each present only when the ledger holds a live
-    measurement for it — preflight keeps its CLI value for absent keys.
+    --ici-bw-gibps, --mem-scale), each present only when the ledger holds
+    a live measurement for it — preflight keeps its CLI value for absent
+    keys.
 
     Rows stamped `context.backend: cpu` are EXCLUDED: a CPU smoke measures
     real numbers about the wrong hardware (an mfu of 1e-4, a device_put
@@ -278,6 +295,7 @@ def derive_calibration(rows: list[dict]) -> dict:
     import statistics
 
     by_metric: dict[str, list[float]] = {}
+    mem_ratios: list[float] = []
     for row in rows:
         meas = _num(row.get("measured"))
         ctx = row.get("context") or {}
@@ -287,6 +305,13 @@ def derive_calibration(rows: list[dict]) -> dict:
         # constant (a failed probe's 0.0 must not zero preflight's model)
         if meas is not None and meas > 0:
             by_metric.setdefault(row.get("metric", ""), []).append(meas)
+        # mem_scale is a RATIO constant (measured live peak / byte-model
+        # peak), so it needs both halves of the same row — unlike the rate
+        # constants above, a lone measurement calibrates nothing
+        if row.get("metric") == "mem_peak_gib":
+            model = _num(row.get("model"))
+            if model and model > 0 and meas is not None and meas > 0:
+                mem_ratios.append(meas / model)
     calib: dict[str, Any] = {}
     mfu = [v for v in by_metric.get("mfu", ()) if v >= 0.01]
     if mfu:
@@ -297,8 +322,10 @@ def derive_calibration(rows: list[dict]) -> dict:
     if by_metric.get("ici_bw_gibps"):
         calib["ici_bw_gibps"] = round(
             statistics.median(by_metric["ici_bw_gibps"]), 2)
+    if mem_ratios:
+        calib["mem_scale"] = round(statistics.median(mem_ratios), 4)
     calib["generated_at"] = time.time()
-    calib["rows_used"] = len(mfu) + sum(
+    calib["rows_used"] = len(mfu) + len(mem_ratios) + sum(
         len(v) for k, v in by_metric.items()
         if k in ("host_bw_gibps", "ici_bw_gibps"))
     return calib

@@ -272,31 +272,12 @@ class RunClock:
 # -- device memory telemetry -------------------------------------------------
 
 def device_peak_bytes() -> tuple[int | None, str]:
-    """(max peak bytes across local devices, source).
+    """(max peak bytes across local devices, source) — delegated to
+    utils/memwatch.py, the memory observatory's one spelling of the poll
+    (the metrics-line key `device_peak_bytes` is unchanged)."""
+    from llama_pipeline_parallel_tpu.utils import memwatch
 
-    TPU/GPU report `memory_stats()["peak_bytes_in_use"]`; the CPU backend
-    returns None, where the process peak RSS (ru_maxrss) stands in so the
-    metrics field exists on every platform — the source tag keeps the two
-    from being compared against each other."""
-    try:
-        import jax
-
-        peaks = []
-        for d in jax.local_devices():
-            stats = d.memory_stats()
-            if stats and stats.get("peak_bytes_in_use") is not None:
-                peaks.append(int(stats["peak_bytes_in_use"]))
-        if peaks:
-            return max(peaks), "device"
-    except Exception as e:
-        logger.debug("memory_stats unavailable: %r", e)
-    try:
-        import resource
-
-        # linux reports ru_maxrss in KiB
-        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024, "host_rss"
-    except Exception:
-        return None, "unavailable"
+    return memwatch.device_peak_bytes()
 
 
 # -- run health --------------------------------------------------------------

@@ -78,7 +78,16 @@ def test_vocab_equals_num_chunks_degenerate():
     np.testing.assert_allclose(dgot[1], dref[1], rtol=1e-5, atol=1e-6)
 
 
-@pytest.mark.parametrize("chunks", [2, 4, 8, 16, 32])
+# Fast lane keeps the pinned boundary points (2, and the measured
+# last-mantissa-bit cases 8/32 the docstring cites); the interior rows
+# exercise no new reassociation order and ride the round gate.
+@pytest.mark.parametrize("chunks", [
+    2,
+    pytest.param(4, marks=pytest.mark.slow),
+    8,
+    pytest.param(16, marks=pytest.mark.slow),
+    32,
+])
 def test_num_chunks_invariance_grid(chunks):
     """Chunk-count invariance of loss AND grads against the chunks=1
     anchor. The online-logsumexp rescaling reassociates exp sums, so the

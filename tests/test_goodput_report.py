@@ -151,6 +151,7 @@ def test_incarnation_ledger_summary(run_dir, capsys):
     rep = goodput_report.build_report(str(run_dir))
     inc = rep["incarnations"]
     assert inc == {"incarnations": 3, "restarts": 2, "crashes": 1, "hangs": 1,
+                   "ooms": 0,
                    "lost_seconds": pytest.approx(50.5), "last_outcome": "clean",
                    "resize_events": 0, "resize_lost_seconds": 0.0,
                    "layouts": [

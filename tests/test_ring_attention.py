@@ -29,7 +29,10 @@ def run_ring(q, k, v, sp, causal=True):
     return jax.jit(fn)(q, k, v)
 
 
-@pytest.mark.parametrize("sp", [2, 4, 8])
+# sp=2 (minimal ring) and sp=8 (whole-mesh ring, every rank both ends of
+# the rotation) are the boundary rows; the interior sp=4 adds no new
+# block-order case and rides the round gate.
+@pytest.mark.parametrize("sp", [2, pytest.param(4, marks=pytest.mark.slow), 8])
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_matches_full(devices, sp, causal):
     q, k, v = rand_qkv(b=2, s=64, h=2, hd=16)

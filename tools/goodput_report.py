@@ -184,6 +184,10 @@ def incarnation_summary(output_dir: str) -> dict | None:
         "restarts": max(len(rows) - 1, 0),
         "crashes": sum(1 for r in failed if r.get("outcome") == "crash"),
         "hangs": sum(1 for r in failed if r.get("outcome") == "hang"),
+        # the supervisor labels an allocation-failure death distinctly
+        # (crash + fresh oom/ snapshot — tools/supervisor.py): a capacity
+        # problem every relaunch will hit again, unlike a transient crash
+        "ooms": sum(1 for r in failed if r.get("outcome") == "oom"),
         "lost_seconds": sum(_num(r.get("duration_s")) or 0.0 for r in failed),
         "resize_events": resizes,
         "resize_lost_seconds": round(resize_lost, 3),
@@ -228,8 +232,9 @@ def supervisor_summary(output_dir: str) -> dict | None:
 # shared counter set (telemetry.SERVE_COUNTER_KEYS, the one spelling)
 _SERVE_GAUGE_KEYS = ("ttft_p95_ms", "tpot_p50_ms", "queue_wait_p95_ms",
                      "pages_used", "pages_free", "pages_reserved",
-                     "prefilling", "prefill_chunks_total",
-                     "prefill_tokens_total")
+                     "reserved_unbacked", "page_fragmentation",
+                     "reserved_gap_bytes", "prefilling",
+                     "prefill_chunks_total", "prefill_tokens_total")
 
 
 def serve_counter_summary(metrics: list[dict]) -> dict | None:
@@ -243,6 +248,30 @@ def serve_counter_summary(metrics: list[dict]) -> dict | None:
     last = serving[-1]
     return {k: last[k] for k in SERVE_COUNTER_KEYS + _SERVE_GAUGE_KEYS
             if k in last}
+
+
+def oom_summary(output_dir: str, top: int = 5) -> dict | None:
+    """Roll-up of the OOM forensics snapshots (`<output_dir>/oom/`, written
+    by the trainer's allocation-failure handler — utils/memwatch.py), or
+    None when the run never OOMed. Tolerant like every other reader: a
+    torn/garbage snapshot contributes nothing, the parseable rest still
+    reports."""
+    from llama_pipeline_parallel_tpu.utils import memwatch
+
+    snaps = memwatch.read_oom_snapshots(output_dir)
+    if not snaps:
+        return None
+    out = {"snapshots": len(snaps), "events": []}
+    for s in snaps[:top]:
+        live = s.get("live") if isinstance(s.get("live"), dict) else {}
+        peak = _num(live.get("device_peak_bytes"))
+        out["events"].append({"step": s.get("step"),
+                              "time": s.get("time"),
+                              "error": str(s.get("error", ""))[:160],
+                              "device_peak_gib": (round(peak / (1 << 30), 2)
+                                                  if peak is not None
+                                                  else None)})
+    return out
 
 
 def numerics_summary(output_dir: str, top: int = 5) -> dict | None:
@@ -295,6 +324,7 @@ def build_report(output_dir: str, top: int = 5) -> dict:
         "supervisor": supervisor_summary(output_dir),
         "incarnations": incarnation_summary(output_dir),
         "numerics": numerics_summary(output_dir, top),
+        "oom": oom_summary(output_dir, top),
         "slowest_windows": slowest_windows(spans, metrics, top),
         "stall_histogram": stall_histogram(spans, "data_wait"),
         "prefetch_stalls": {
@@ -329,10 +359,11 @@ def print_report(rep: dict) -> None:
 
     inc = rep.get("incarnations")
     if inc:
+        ooms = (f", {inc['ooms']} oom(s)" if inc.get("ooms") else "")
         print(f"\n== incarnations (supervisor ledger) ==\n"
               f"  {inc['incarnations']} launch(es), {inc['restarts']} "
               f"restart(s): {inc['crashes']} crash(es), {inc['hangs']} "
-              f"hang(s); {inc['lost_seconds']:.1f} s lost to failed "
+              f"hang(s){ooms}; {inc['lost_seconds']:.1f} s lost to failed "
               f"incarnations; last outcome: {inc['last_outcome']}")
         if inc.get("resize_events"):
             # crash duration + relaunch gap around each resize — the gap is
@@ -348,6 +379,16 @@ def print_report(rep: dict) -> None:
                            if l.get("devices") is not None else "")
                 print(f"    #{l['incarnation']}: {l['layout'] or '?'}"
                       f"{devices}  [{l['outcome']}]{mark}")
+
+    oom = rep.get("oom")
+    if oom:
+        print(f"\n== oom forensics ({oom['snapshots']} snapshot(s), "
+              f"newest first) ==")
+        for e in oom["events"]:
+            peak = (f"  device peak {e['device_peak_gib']} GiB"
+                    if e.get("device_peak_gib") is not None else "")
+            print(f"    step {e.get('step')}: {e.get('error')}{peak}")
+        print("  (full snapshots: <output_dir>/oom/)")
 
     num = rep.get("numerics")
     if num:

@@ -137,6 +137,92 @@ def verify_digests(root: str, step: int) -> dict:
             "counts": counts, "files": files}
 
 
+def sizes(root: str, step: int) -> dict:
+    """Measured on-disk bytes per tree (top-level dir under the step) next
+    to the byte model's stage-weight terms — "is the checkpoint the size
+    the model says the state is". Degrades on a pre-elastic meta (no
+    model_config / layer_counts): measured bytes still report, the model
+    side says why it cannot."""
+    import dataclasses as _dc
+
+    from llama_pipeline_parallel_tpu.ckpt.checkpoint import CheckpointManager
+
+    gib = 1 << 30
+    mgr = CheckpointManager(root)
+    step_dir = mgr.step_dir(step)
+    trees: dict[str, dict] = {}
+    total = 0
+    for dirpath, _, files in os.walk(step_dir):
+        for name in files:
+            full = os.path.join(dirpath, name)
+            try:
+                n = os.path.getsize(full)
+            except OSError:  # racing a delete — count what's readable
+                continue
+            rel = os.path.relpath(full, step_dir).replace(os.sep, "/")
+            tree = rel.split("/", 1)[0] if "/" in rel else "(root)"
+            t = trees.setdefault(tree, {"bytes": 0, "files": 0})
+            t["bytes"] += n
+            t["files"] += 1
+            total += n
+    out: dict = {
+        "step": step,
+        "total_gib": round(total / gib, 3),
+        "trees": {k: {"gib": round(v["bytes"] / gib, 3),
+                      "bytes": v["bytes"], "files": v["files"]}
+                  for k, v in sorted(trees.items())},
+    }
+    meta = mgr.load_meta(step) if mgr.is_complete(step) else {}
+    mc = meta.get("model_config")
+    if not isinstance(mc, dict):
+        out["model"] = ("unavailable — meta.json carries no model_config "
+                        "(pre-elastic format, or incomplete step); measured "
+                        "bytes only")
+        return out
+    try:
+        import numpy as np
+
+        from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+        from llama_pipeline_parallel_tpu.utils.metrics import param_count
+
+        known = {f.name for f in _dc.fields(LlamaConfig)}
+        cfg = LlamaConfig(**{k: v for k, v in mc.items() if k in known})
+        itemsize = np.dtype(cfg.dtype).itemsize
+        n_params = param_count(cfg)
+        model: dict = {
+            "param_count": n_params,
+            "param_dtype": str(cfg.dtype),
+            # checkpointed params in the model dtype; optimizer state is
+            # two fp32 Adam moments per param (optax adamw)
+            "params_gib": round(n_params * itemsize / gib, 3),
+        }
+        if meta.get("has_optimizer_state"):
+            model["opt_state_gib"] = round(n_params * 2 * 4 / gib, 3)
+        man = meta.get("manifest") or {}
+        topo = meta.get("topology") or {}
+        counts = man.get("layer_counts") or topo.get("layer_counts")
+        if isinstance(counts, (list, tuple)) and counts:
+            # per-stage weight terms, the same split preflight's byte model
+            # charges each pipeline stage: per-layer params plus embedding
+            # on the first stage, head + final norm on the last
+            d, f_, V = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+            kv_dim = cfg.kv_heads * cfg.head_dim
+            per_layer = d * d * 2 + d * kv_dim * 2 + 3 * d * f_ + 2 * d
+            stage_gib = []
+            for i, layers in enumerate(counts):
+                p = int(layers) * per_layer
+                if i == 0:
+                    p += V * d
+                if i == len(counts) - 1:
+                    p += V * d + d
+                stage_gib.append(round(p * itemsize / gib, 3))
+            model["stage_weight_gib"] = stage_gib
+        out["model"] = model
+    except Exception as e:  # a foreign/garbage model_config must degrade
+        out["model"] = f"unavailable — model_config not loadable ({e})"
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("root", help="checkpoint output_dir")
@@ -145,9 +231,21 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--verify", action="store_true",
                    help="recompute per-file sha256 digests against meta.json "
                         "and report OK/MISMATCH/missing per file")
+    p.add_argument("--sizes", action="store_true",
+                   help="per-tree on-disk bytes next to the byte model's "
+                        "stage-weight terms (degrades to measured-only on "
+                        "pre-elastic meta)")
     args = p.parse_args(argv)
     out = describe(args.root, args.step)
     rc = 0
+    if args.sizes:
+        step = (args.step if args.step is not None
+                else out.get("latest_complete_step"))
+        if step is None:
+            out["sizes"] = {"status": "NO_CHECKPOINT",
+                            "detail": "no complete checkpoint to size"}
+        else:
+            out["sizes"] = sizes(args.root, step)
     if args.verify:
         step = (args.step if args.step is not None
                 else out.get("latest_complete_step"))

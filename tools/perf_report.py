@@ -13,10 +13,13 @@ included), and prints:
 - the **failure summary**: reason-tagged rows ("N rounds unreachable" —
   the standing TPU gap, summarized instead of silently dropped);
 - with `--emit-calibration PATH`: the measured-constants JSON
-  (`mfu`, `host_bw_gibps`, `ici_bw_gibps` — whichever the ledger holds)
-  that `tools/preflight.py --select --calibration PATH` consumes to
-  re-rank the layout/schedule frontier from measurements instead of CLI
-  guesses — the analytic half of ROADMAP's "measured re-selection".
+  (`mfu`, `host_bw_gibps`, `ici_bw_gibps`, `mem_scale` — whichever the
+  ledger holds) that `tools/preflight.py --select --calibration PATH`
+  consumes to re-rank the layout/schedule frontier from measurements
+  instead of CLI guesses — the analytic half of ROADMAP's "measured
+  re-selection". `mem_scale` (live peak / byte-model peak, from the
+  memory observatory's `mem_peak_gib` rows) scales the selector's
+  est_peak_gib feasibility test.
 
 Degrades, never tracebacks: missing/torn/garbage ledgers and archives
 contribute whatever parses (the goodput_report house rule).
@@ -123,7 +126,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.emit_calibration:
         calib = perf.derive_calibration(rows)
         usable = {k: v for k, v in calib.items()
-                  if k in ("mfu", "host_bw_gibps", "ici_bw_gibps")}
+                  if k in ("mfu", "host_bw_gibps", "ici_bw_gibps",
+                           "mem_scale")}
         with open(args.emit_calibration, "w") as f:
             json.dump(calib, f, indent=2)
         if usable:

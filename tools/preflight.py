@@ -240,7 +240,8 @@ def solver_candidates(num_stages: int, microbatches: int, num_layers: int,
                       base_gib: float, dims: tuple, hbm_gb: float,
                       max_virtual: int = 4,
                       accum_options: tuple = (1, 2, 4, 8),
-                      head_gib: float = 0.0) -> list:
+                      head_gib: float = 0.0,
+                      mem_scale: float = 1.0) -> list:
     """Solver-EMITTED sequences as selection candidates (the list-scheduling
     search beyond the three canonical shapes — docs/SCHEDULES.md 'Solver
     schedules'). For each split-backward (v, accum, W-placement) grid
@@ -305,7 +306,12 @@ def solver_candidates(num_stages: int, microbatches: int, num_layers: int,
                     s = usched.with_offload(seq, vector)
                     stash = _stash_device_bytes(s.wq_hbm_slots,
                                                 s.wq_host_slots, slot)
-                    return base_gib + (ring + stash) / gib + head_gib
+                    # mem_scale: the calibrated live/model peak ratio
+                    # (perf.derive_calibration) — the SAME scaling
+                    # select_schedule applies, or the vector would be
+                    # sized against a different budget than it's scored by
+                    return (base_gib + (ring + stash) / gib
+                            + head_gib) * mem_scale
 
                 n = seq.n_units
                 if est(np.zeros(n, bool)) <= hbm_gb:
@@ -334,7 +340,8 @@ def solver_candidates(num_stages: int, microbatches: int, num_layers: int,
 def select_schedule(candidates: list, base_gib: float, dims: tuple,
                     hbm_gb: float, host_bw_gibps: float,
                     step_compute_fn, hide_max: float = 1.0,
-                    vocab: int | None = None) -> tuple:
+                    vocab: int | None = None,
+                    mem_scale: float = 1.0) -> tuple:
     """Score every candidate against the HBM budget AND the host-bandwidth
     bound, and pick the feasible one with the lowest analytic bubble
     (ties: lower host residency first — never move bytes for nothing —
@@ -344,6 +351,10 @@ def select_schedule(candidates: list, base_gib: float, dims: tuple,
     peak minus ITS ring/stash (and, with `vocab`, loss-head) terms.
     `step_compute_fn(pcfg) -> seconds` models the overlap budget
     (accum_chunks does not change it — same flops, more flushes).
+    `mem_scale` (measured live peak / byte-model peak, from the memory
+    observatory via `--calibration`) scales every candidate's estimate —
+    a >1 ratio tightens the feasibility cut to what the live telemetry
+    actually saw, re-ranking the frontier from measurement.
     Returns (winner_row_or_None, all_rows)."""
     from llama_pipeline_parallel_tpu.parallel import pipeline as pl
 
@@ -351,7 +362,7 @@ def select_schedule(candidates: list, base_gib: float, dims: tuple,
     for pcfg in candidates:
         terms = candidate_device_terms_gib(pcfg, dims, vocab)
         est = (base_gib + terms["ring_gib"] + terms["stash_gib"]
-               + terms["loss_head_gib"])
+               + terms["loss_head_gib"]) * mem_scale
         feas = offload_feasibility(pcfg, dims, step_compute_fn(pcfg),
                                    host_bw_gibps)
         fits_hbm = est <= hbm_gb
@@ -577,7 +588,7 @@ def layout_frontier(model_cfg, devices: int, mb_rows: int, seq: int,
                     optimizer_offload: bool = True, zero2: bool = True,
                     loss_chunks_aw: int = 1, vocab_enabled: bool = True,
                     solver_lane: bool = True,
-                    max_virtual: int = 4) -> tuple:
+                    max_virtual: int = 4, mem_scale: float = 1.0) -> tuple:
     """The full (pp, tp, dp, sp) frontier at `devices` chips: per layout,
     re-run the schedule/offload/ce selection against the memory model at
     THAT mesh (base re-derived analytically, calibrated by the residual
@@ -621,13 +632,15 @@ def layout_frontier(model_cfg, devices: int, mb_rows: int, seq: int,
             cands += solver_candidates(pp, micro,
                                        model_cfg.num_hidden_layers, base,
                                        dims, hbm_gb, max_virtual=max_virtual,
-                                       head_gib=solver_head)
+                                       head_gib=solver_head,
+                                       mem_scale=mem_scale)
         mesh_cfg = MeshConfig(pp=pp, tp=tp, dp=dp, sp=sp)
         compute_fn = lambda c, _mc=mesh_cfg: _step_compute_seconds(
             model_cfg, _mc, c, mb_rows, seq, mfu, chip_flops)
         sched_winner, _ = select_schedule(cands, base, dims, hbm_gb,
                                           host_bw_gibps, compute_fn,
-                                          hide_max=hide_max, vocab=vocab)
+                                          hide_max=hide_max, vocab=vocab,
+                                          mem_scale=mem_scale)
         row = {"pp": pp, "tp": tp, "dp": dp, "sp": sp,
                "layout": f"pp{pp}xtp{tp}xdp{dp}xsp{sp}",
                "microbatches": micro,
@@ -786,58 +799,20 @@ def stash_remedies(pcfg) -> str:
     return "; ".join(parts)
 
 
-def preflight(cfg: dict, hbm_gb: float, host_bw_gibps: float = 30.0,
-              mfu: float = 0.45, hide_max: float = 1.0,
-              chip_flops: float | None = None) -> dict:
-    """Lower + compile the training step ABSTRACTLY (no arrays materialize:
-    65B fp32 masters never exist) and return the per-device byte breakdown."""
+def _compile_abstract(cfg: dict, mesh, mesh_cfg, model_cfg, manifest, pcfg):
+    """Lower + compile the trainer's own program ABSTRACTLY (eval_shape
+    state, ShapeDtypeStruct batch — no arrays materialize) and return
+    ``(compiled, seq)``. Shared by preflight() and memory_audit(): both
+    must compile the SAME program the real run executes, at whatever
+    accum shape their caller baked into ``cfg``/``pcfg``."""
     import jax
-    import numpy as np
     from jax.sharding import NamedSharding
 
     from llama_pipeline_parallel_tpu.models.llama import model as llama
     from llama_pipeline_parallel_tpu.optim import OptimizerConfig, make_optimizer
     from llama_pipeline_parallel_tpu.parallel import pipeline as pl
     from llama_pipeline_parallel_tpu.parallel import train_step as ts
-    from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
-    from llama_pipeline_parallel_tpu.train import (
-        build_manifest,
-        build_model_config,
-        build_pipeline_config,
-        select_attention,
-    )
-
-    if cfg.get("optimizer_offload_zero2") and not cfg.get("optimizer_offload"):
-        # mirror the trainer's rejection (train.py) — preflight passing a
-        # config the real run refuses defeats its purpose
-        raise ValueError("optimizer_offload_zero2 requires optimizer_offload: "
-                         "true")
-    mesh_cfg = MeshConfig(**cfg.get("mesh", {}))
-    mesh = make_mesh(mesh_cfg)
-    model_cfg = build_model_config(cfg["model"])
-    # the trainer's own builders: the preflight must compile the SAME program
-    manifest = build_manifest(cfg, model_cfg, mesh_cfg.pp)
-    pcfg = build_pipeline_config(cfg, mesh_cfg, manifest)
-
-    # Anchored-compile mode for host-offload configs on backends that
-    # cannot express host memory (utils/host_stash.py gating — XLA-CPU,
-    # i.e. every CLI preflight): the gated-off compile holds the tiered
-    # stash DEVICE-resident, and XLA-CPU additionally over-counts stash
-    # buffers past 2^31 elements (~2.4x at the 65B micro-8 shape, where
-    # the same program at micro 2 — exactly 2^31 — and the whole 7B grid
-    # match the closed-form model to the 0.1 GiB). So the device peak is
-    # estimated from a compile of the SAME program at the smallest valid
-    # M (queue shrunk under the cliff), with the schedule's ring/stash
-    # terms swapped to the real shape analytically — every other term is
-    # M-independent (ring slots cap at 2vS-1; scan trip counts are free).
-    pcfg_real, anchor_m = pcfg, None
-    if ((pcfg.offload_wgrad or pcfg.offload_activations)
-            and not _host_transfers_enabled()):
-        m_min = pcfg.num_stages * pcfg.accum_chunks
-        if m_min < pcfg.num_microbatches:
-            anchor_m = m_min
-            cfg = {**cfg, "gradient_accumulation_steps": m_min}
-            pcfg = build_pipeline_config(cfg, mesh_cfg, manifest)
+    from llama_pipeline_parallel_tpu.train import select_attention
 
     # the trainer probes the collator for the real row length; the synthetic
     # dataset's seq_length is that probe's answer for these configs
@@ -918,6 +893,60 @@ def preflight(cfg: dict, hbm_gb: float, host_bw_gibps: float = 30.0,
         step = ts.make_train_step(mesh, model_cfg, pcfg, tx, sched, stacked_abs,
                                   attn_fn=attn_fn)
         compiled = step.lower(state_abs, batch_abs).compile()
+    return compiled, seq
+
+
+def preflight(cfg: dict, hbm_gb: float, host_bw_gibps: float = 30.0,
+              mfu: float = 0.45, hide_max: float = 1.0,
+              chip_flops: float | None = None) -> dict:
+    """Lower + compile the training step ABSTRACTLY (no arrays materialize:
+    65B fp32 masters never exist) and return the per-device byte breakdown."""
+    import jax
+    import numpy as np
+
+    from llama_pipeline_parallel_tpu.models.llama import model as llama
+    from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+    from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
+    from llama_pipeline_parallel_tpu.train import (
+        build_manifest,
+        build_model_config,
+        build_pipeline_config,
+    )
+
+    if cfg.get("optimizer_offload_zero2") and not cfg.get("optimizer_offload"):
+        # mirror the trainer's rejection (train.py) — preflight passing a
+        # config the real run refuses defeats its purpose
+        raise ValueError("optimizer_offload_zero2 requires optimizer_offload: "
+                         "true")
+    mesh_cfg = MeshConfig(**cfg.get("mesh", {}))
+    mesh = make_mesh(mesh_cfg)
+    model_cfg = build_model_config(cfg["model"])
+    # the trainer's own builders: the preflight must compile the SAME program
+    manifest = build_manifest(cfg, model_cfg, mesh_cfg.pp)
+    pcfg = build_pipeline_config(cfg, mesh_cfg, manifest)
+
+    # Anchored-compile mode for host-offload configs on backends that
+    # cannot express host memory (utils/host_stash.py gating — XLA-CPU,
+    # i.e. every CLI preflight): the gated-off compile holds the tiered
+    # stash DEVICE-resident, and XLA-CPU additionally over-counts stash
+    # buffers past 2^31 elements (~2.4x at the 65B micro-8 shape, where
+    # the same program at micro 2 — exactly 2^31 — and the whole 7B grid
+    # match the closed-form model to the 0.1 GiB). So the device peak is
+    # estimated from a compile of the SAME program at the smallest valid
+    # M (queue shrunk under the cliff), with the schedule's ring/stash
+    # terms swapped to the real shape analytically — every other term is
+    # M-independent (ring slots cap at 2vS-1; scan trip counts are free).
+    pcfg_real, anchor_m = pcfg, None
+    if ((pcfg.offload_wgrad or pcfg.offload_activations)
+            and not _host_transfers_enabled()):
+        m_min = pcfg.num_stages * pcfg.accum_chunks
+        if m_min < pcfg.num_microbatches:
+            anchor_m = m_min
+            cfg = {**cfg, "gradient_accumulation_steps": m_min}
+            pcfg = build_pipeline_config(cfg, mesh_cfg, manifest)
+
+    compiled, seq = _compile_abstract(cfg, mesh, mesh_cfg, model_cfg,
+                                      manifest, pcfg)
     ma = compiled.memory_analysis()
     if ma is None:
         raise RuntimeError("backend exposes no compile-time memory analysis")
@@ -1061,9 +1090,141 @@ def preflight(cfg: dict, hbm_gb: float, host_bw_gibps: float = 30.0,
     if cfg.get("optimizer_offload"):
         # host side: fp32 masters + two fp32 Adam moments, sharded per
         # process (optim/offload.py keeps only each host's device shards)
+        stacked_abs = jax.eval_shape(
+            lambda rng: pl.stack_stages(llama.init_params(rng, model_cfg),
+                                        manifest),
+            jax.random.PRNGKey(0))
         n_params = sum(int(np.prod(a.shape)) for a in jax.tree.leaves(stacked_abs))
         report["host_dram_total_gib"] = round(n_params * 12 / gib, 1)
     return report
+
+
+def memory_audit(cfg: dict, top_buffers: int = 8) -> dict:
+    """Per-buffer evidence behind the anchored-estimate mode: compile the
+    SAME program at a ladder of microbatch counts and, per rung, put the
+    byte model's candidate terms (candidate_device_terms_gib) next to
+    `memory_analysis()`'s raw numbers plus top-buffer attribution
+    (utils/memwatch.py). The residual (raw peak minus the model's ring +
+    stash terms) is M-independent when XLA counts honestly — a residual
+    that JUMPS between rungs localizes the over-count to the buffers the
+    attribution lists, which is exactly the 2^31-element XLA-CPU cliff
+    the anchored mode in preflight() works around
+    (docs/PREFLIGHT.md "Memory audit")."""
+    from llama_pipeline_parallel_tpu.parallel import pipeline as pl
+    from llama_pipeline_parallel_tpu.parallel.mesh import MeshConfig, make_mesh
+    from llama_pipeline_parallel_tpu.train import (
+        build_manifest,
+        build_model_config,
+        build_pipeline_config,
+    )
+    from llama_pipeline_parallel_tpu.utils import memwatch
+
+    gib = 1 << 30
+    mesh_cfg = MeshConfig(**cfg.get("mesh", {}))
+    mesh = make_mesh(mesh_cfg)
+    model_cfg = build_model_config(cfg["model"])
+    manifest = build_manifest(cfg, model_cfg, mesh_cfg.pp)
+    pcfg_real = build_pipeline_config(cfg, mesh_cfg, manifest)
+
+    # M-ladder: the smallest valid microbatch count (the anchored mode's
+    # compile shape), the as-written M, and a midpoint rung when the two
+    # are far apart — three points separate "residual is flat" from
+    # "residual jumps at one rung".
+    m_min = pcfg_real.num_stages * pcfg_real.accum_chunks
+    m_real = pcfg_real.num_microbatches
+    ladder = {m for m in (m_min, m_real) if m >= m_min}
+    if m_real >= 4 * m_min:
+        ladder.add(2 * m_min)
+    mb_rows = int(cfg.get("per_device_train_batch_size", 1))
+
+    rungs = []
+    for m in sorted(ladder):
+        cfg_m = {**cfg, "gradient_accumulation_steps": m}
+        try:
+            pcfg_m = build_pipeline_config(cfg_m, mesh_cfg, manifest)
+            compiled, seq = _compile_abstract(cfg_m, mesh, mesh_cfg,
+                                              model_cfg, manifest, pcfg_m)
+        except Exception as e:  # invalid rung (schedule constraint) — skip
+            rungs.append({"microbatches": m, "error": f"{type(e).__name__}: {e}"})
+            continue
+        info = memwatch.compiled_memory(compiled, top_buffers=top_buffers,
+                                        label=f"M={m}")
+        if info is None:
+            rungs.append({"microbatches": m,
+                          "error": "backend exposes no memory analysis"})
+            continue
+        dims = pl.stash_dims(mb_rows, seq, mesh_cfg.sp, model_cfg.hidden_size,
+                             model_cfg.dtype)
+        terms = candidate_device_terms_gib(pcfg_m, dims)
+        peak_gib = info["peak_bytes"] / gib
+        # flag buffers past the XLA-CPU over-count cliff: 2^31 ELEMENTS
+        bufs = []
+        for b in info["top_buffers"]:
+            elements = 1
+            for d in b.get("shape") or ():
+                elements *= d
+            bufs.append({**b, "gib": round(b["bytes"] / gib, 2),
+                         "over_2^31_elements": elements >= (1 << 31)})
+        rungs.append({
+            "microbatches": m,
+            "anchor_rung": m == m_min and m != m_real,
+            "as_written": m == m_real,
+            "raw_peak_gib": round(peak_gib, 2),
+            "arguments_gib": round(info["argument_bytes"] / gib, 2),
+            "outputs_gib": round(info["output_bytes"] / gib, 2),
+            "temp_gib": round(info["temp_bytes"] / gib, 2),
+            "ring_gib": round(terms["ring_gib"], 2),
+            "stash_gib": round(terms["stash_gib"], 2),
+            "loss_head_gib": round(terms["loss_head_gib"], 2),
+            "residual_gib": round(peak_gib - terms["ring_gib"]
+                                  - terms["stash_gib"], 2),
+            "top_buffers": bufs,
+        })
+    return {"schedule": pcfg_real.schedule, "anchor_microbatches": m_min,
+            "as_written_microbatches": m_real,
+            "devices": _prod(mesh.shape.values()),
+            "rungs": rungs}
+
+
+def _prod(vals) -> int:
+    out = 1
+    for v in vals:
+        out *= int(v)
+    return out
+
+
+def print_memory_audit(audit: dict) -> None:
+    """The --memory-audit table: one row per ladder rung, residual last —
+    a flat residual column validates the byte model's M-scaling; a jump
+    names the over-counted rung, and the per-rung buffer attribution
+    below names the tensor (docs/PREFLIGHT.md commits these tables for
+    the 7B and 65B conf shapes)."""
+    print(f"memory audit: schedule {audit['schedule']}, "
+          f"anchor M={audit['anchor_microbatches']}, "
+          f"as-written M={audit['as_written_microbatches']}")
+    hdr = (f"{'M':>6s} {'raw_peak':>9s} {'temp':>8s} {'ring':>7s} "
+           f"{'stash':>7s} {'head':>7s} {'residual':>9s}  note")
+    print(hdr)
+    for r in audit["rungs"]:
+        if "error" in r:
+            print(f"{r['microbatches']:>6d} {'-':>9s} {'-':>8s} {'-':>7s} "
+                  f"{'-':>7s} {'-':>7s} {'-':>9s}  {r['error']}")
+            continue
+        note = ("anchor" if r.get("anchor_rung")
+                else "as-written" if r.get("as_written") else "")
+        print(f"{r['microbatches']:>6d} {r['raw_peak_gib']:>9.2f} "
+              f"{r['temp_gib']:>8.2f} {r['ring_gib']:>7.2f} "
+              f"{r['stash_gib']:>7.2f} {r['loss_head_gib']:>7.2f} "
+              f"{r['residual_gib']:>9.2f}  {note}")
+    for r in audit["rungs"]:
+        if "error" in r or not r.get("top_buffers"):
+            continue
+        print(f"\ntop buffers at M={r['microbatches']}:")
+        for b in r["top_buffers"]:
+            flag = "  <-- over 2^31 elements" if b["over_2^31_elements"] else ""
+            shape = ",".join(str(d) for d in (b.get("shape") or ()))
+            print(f"  {b['gib']:>8.2f} GiB  {b['dtype']}[{shape}]  "
+                  f"%{b['name']}{flag}")
 
 
 def calibrate() -> dict:
@@ -1272,7 +1433,8 @@ def _run_all(patterns: list[str], hbm_gb: float, overrides: list[str]) -> None:
 
 
 CALIBRATION_KEYS = {"mfu": "mfu", "host_bw_gibps": "host_bw_gibps",
-                    "ici_bw_gibps": "ici_bw_gibps"}
+                    "ici_bw_gibps": "ici_bw_gibps",
+                    "mem_scale": "mem_scale"}
 
 
 def load_calibration(path: str) -> dict:
@@ -1380,16 +1542,29 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--hide-ratio-max", type=float, default=1.0,
                    help="reject offload whose modeled transfer/compute "
                         "ratio exceeds this")
+    p.add_argument("--mem-scale", type=float, default=1.0,
+                   help="measured live-peak / byte-model-peak ratio "
+                        "scaling every --select candidate's est_peak_gib "
+                        "(1.0 = trust the model; the memory observatory's "
+                        "mem_peak_gib rows calibrate it via --calibration)")
+    p.add_argument("--memory-audit", action="store_true",
+                   help="compile the SAME program at a ladder of "
+                        "microbatch counts and print the per-term "
+                        "byte-model vs memory_analysis() residual table "
+                        "with top-buffer attribution — the per-buffer "
+                        "evidence behind the anchored-estimate mode "
+                        "(docs/PREFLIGHT.md 'Memory audit')")
     p.add_argument("--chip-flops", type=float, default=None,
                    help="chip peak FLOP/s for the compute model (default: "
                         "detect, else 197e12)")
     p.add_argument("--calibration", default=None, metavar="JSON",
                    help="measured constants file from tools/perf_report.py "
                         "--emit-calibration: keys present there (mfu, "
-                        "host_bw_gibps, ici_bw_gibps) override the CLI "
-                        "assumptions above, so --select re-ranks the "
-                        "frontier from MEASURED bandwidth/MFU instead of "
-                        "guesses (docs/PREFLIGHT.md 'Calibration')")
+                        "host_bw_gibps, ici_bw_gibps, mem_scale) override "
+                        "the CLI assumptions above, so --select re-ranks "
+                        "the frontier from MEASURED bandwidth/MFU/memory "
+                        "instead of guesses (docs/PREFLIGHT.md "
+                        "'Calibration')")
     p.add_argument("overrides", nargs="*", help="key=value config overrides")
     args, unknown = p.parse_known_args(argv)
     bad = [u for u in unknown if not (u.startswith("--") and "=" in u)]
@@ -1459,6 +1634,9 @@ def main(argv: list[str] | None = None) -> None:
         print("resume preflight (elastic — docs/RESILIENCE.md):")
         for k, v in resume.items():
             print(f"  {k}: {v}")
+    if args.memory_audit:
+        print()
+        print_memory_audit(memory_audit(cfg))
     if args.select:
         _print_selection(cfg, report, args)
     elif args.emit_schedule:
@@ -1515,6 +1693,7 @@ def _print_selection(cfg: dict, report: dict, args) -> None:
     # candidates are offered CHUNKED only — at loss_chunks=1 the kernel's
     # [d, V] weight block cannot fit VMEM at production vocabs.
     vocab = model_cfg.vocab_size if mesh_cfg.tp <= 1 else None
+    mem_scale = getattr(args, "mem_scale", 1.0) or 1.0
     terms = candidate_device_terms_gib(pcfg, dims, vocab)
     base = (report["per_device_peak_gib"] - terms["ring_gib"]
             - terms["stash_gib"] - terms["loss_head_gib"])
@@ -1545,13 +1724,15 @@ def _print_selection(cfg: dict, report: dict, args) -> None:
         candidates += solver_candidates(mesh_cfg.pp, pcfg.num_microbatches,
                                         model_cfg.num_hidden_layers, base,
                                         dims, args.hbm_gb,
-                                        head_gib=solver_head)
+                                        head_gib=solver_head,
+                                        mem_scale=mem_scale)
     winner, rows = select_schedule(
         candidates, base, dims, args.hbm_gb, args.host_bw_gibps, compute_fn,
-        hide_max=args.hide_ratio_max, vocab=vocab)
+        hide_max=args.hide_ratio_max, vocab=vocab, mem_scale=mem_scale)
+    scale_note = (f", mem_scale {mem_scale}" if mem_scale != 1.0 else "")
     print(f"schedule selection ({len(rows)} candidates; base "
           f"{round(base, 2)} GiB + per-candidate ring/stash/loss-head; "
-          f"bw {args.host_bw_gibps} GiB/s, mfu {args.mfu}):")
+          f"bw {args.host_bw_gibps} GiB/s, mfu {args.mfu}{scale_note}):")
     print(f"  {'schedule':<17} {'v':>2} {'c':>2} {'offload':<14} "
           f"{'ce':<10} {'peak GiB':>9} {'host GiB':>9} {'head GiB':>9} "
           f"{'bubble%':>8} {'hide':>6}  verdict")
@@ -1617,7 +1798,8 @@ def _print_layout_frontier(cfg: dict, args, model_cfg, mesh_cfg, pcfg,
               hide_max=args.hide_ratio_max,
               optimizer_offload=bool(cfg.get("optimizer_offload")),
               zero2=bool(cfg.get("optimizer_offload_zero2")),
-              loss_chunks_aw=pcfg.loss_chunks)
+              loss_chunks_aw=pcfg.loss_chunks,
+              mem_scale=getattr(args, "mem_scale", 1.0) or 1.0)
     # the display frontier ranks LAYOUTS, and the layout score depends on
     # the bubble, not on where the W residuals live — the canonical lane
     # ranks identically, so the solver refinement (slower: a per-unit

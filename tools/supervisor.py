@@ -407,6 +407,17 @@ class Supervisor:
             time.sleep(self.cfg.poll_s)
         self._child = None
         ended = _now()
+        if outcome == "crash":
+            # OOM forensics (utils/memwatch.py): the trainer's allocation-
+            # failure handler dumps a snapshot into <output_dir>/oom/
+            # before re-raising. A snapshot newer than THIS incarnation's
+            # launch means memory pressure killed it — labeled distinctly
+            # so goodput_report separates capacity problems (every restart
+            # will OOM again) from transient crashes (a restart may help).
+            from llama_pipeline_parallel_tpu.utils import memwatch
+            oom_mtime = memwatch.latest_oom_mtime(self.cfg.output_dir)
+            if oom_mtime is not None and oom_mtime > started:
+                outcome = "oom"
         health = read_health(self.cfg.output_dir) or {}
         # a health.json the DEAD PREVIOUS incarnation wrote must not label
         # this one (same staleness rule as _heartbeat_age): an incarnation
