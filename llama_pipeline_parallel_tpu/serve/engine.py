@@ -59,7 +59,7 @@ from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
 from llama_pipeline_parallel_tpu.models.llama.decode import GenerationConfig
 from llama_pipeline_parallel_tpu.serve.pages import PagedKVCache
 from llama_pipeline_parallel_tpu.serve.slots import SlotKVCache
-from llama_pipeline_parallel_tpu.serve.telemetry import SLOStats
+from llama_pipeline_parallel_tpu.serve.telemetry import SLOStats, retry_after_s
 from llama_pipeline_parallel_tpu.utils import trace
 from llama_pipeline_parallel_tpu.utils.logging import get_logger
 
@@ -321,6 +321,11 @@ class ServeEngine:
         self._prefilling: deque = deque()   # paged chunked admissions
         self._queue: deque = deque()
         self._closed = False
+        # degraded-mode admission (docs/RESILIENCE.md "Actuation"): while
+        # set (draining for a deploy restart, a mid-resize tier), submits
+        # shed coherently — 429 + honest Retry-After — instead of queueing
+        # work this process will not live to finish
+        self._degraded: str | None = None
         self._lock = threading.Lock()
         self._work = threading.Event()   # ServeLoop parks on this when idle
         self._sample_first = jax.jit(decode.sample_rowwise)
@@ -339,6 +344,27 @@ class ServeEngine:
     def queue_depth(self) -> int:
         with self._lock:
             return len(self._queue)
+
+    def _retry_after(self, request: "ServeRequest") -> float:
+        """Honest Retry-After (telemetry.retry_after_s): backlog ahead of
+        this request / measured drain rate + deterministic per-request
+        jitter. Called with the engine lock held — the SLOStats lock is
+        leaf-only, so the nesting can never invert."""
+        pending = (len(self._queue) + len(self._occupants)
+                   + len(self._prefilling))
+        return retry_after_s(pending, self.stats.drain_rate(),
+                             key=request.request_id)
+
+    def set_degraded(self, reason: str) -> None:
+        """Enter degraded-mode admission: every submit sheds with 429 +
+        honest Retry-After until cleared. In-flight and already-queued
+        requests keep decoding — degraded is about NEW work only."""
+        with self._lock:
+            self._degraded = reason
+
+    def clear_degraded(self) -> None:
+        with self._lock:
+            self._degraded = None
 
     def pick_bucket(self, prompt_len: int, max_new_tokens: int) -> int:
         """Smallest configured bucket holding the prompt whose budget still
@@ -379,10 +405,24 @@ class ServeEngine:
         with self._lock:
             if self._closed:  # a late submit must fail loudly, never hang
                 raise EngineShutdown("serve engine shut down")
+            if self._degraded is not None:
+                # shed, don't queue: this process is draining/mid-resize;
+                # the honest hint covers the time to finish what it WILL
+                # serve (a relaunched replica is up well within it)
+                self.stats.record_rejected()
+                exc = ServeOverloaded(
+                    f"degraded ({self._degraded}) — retry on this or "
+                    f"another replica")
+                exc.retry_after_s = self._retry_after(request)
+                raise exc
             if len(self._queue) >= self.serve_cfg.max_queue:
                 self.stats.record_rejected()
-                raise ServeOverloaded(
+                exc = ServeOverloaded(
                     f"wait queue full ({self.serve_cfg.max_queue})")
+                # honest backpressure: the measured time for the backlog
+                # ahead to drain, not a static hint
+                exc.retry_after_s = self._retry_after(request)
+                raise exc
             if demand and not self.slots.reserve(demand):
                 # refuse NOW: admitting would strand the request mid-decode
                 # when the pool runs dry under it
@@ -392,7 +432,8 @@ class ServeEngine:
                     f"free-page pool cannot cover the worst-case demand of "
                     f"{demand} pages ({self.slots.pages_free} free, "
                     f"{self.slots.pages_reserved}/{self.slots.num_pages} "
-                    f"reserved) — retry after a request completes")
+                    f"reserved) — retry after a request completes",
+                    retry_after_s=self._retry_after(request))
             self._queue.append((request, handle, demand))
         self._work.set()
         return handle
@@ -737,6 +778,8 @@ class ServeEngine:
         snap["queue_depth"] = self.queue_depth()
         snap["slot_allocations"] = self.slots.allocations
         snap["decode_steps"] = self.steps
+        if self._degraded is not None:
+            snap["degraded"] = self._degraded
         if self._paged:
             scfg = self.serve_cfg
             snap["kv_cache"] = "paged"

@@ -25,6 +25,8 @@ from __future__ import annotations
 import collections
 import dataclasses
 import threading
+import time
+import zlib
 
 # the cumulative serving counters every offline report surfaces next to
 # the SLO percentiles (requests_* / slo_breaches / tokens_generated) —
@@ -57,6 +59,30 @@ class SLOThresholds:
         if self.queue_wait_s is not None and queue_wait > self.queue_wait_s:
             out.append("queue_wait")
         return out
+
+
+# trailing window the admission drain rate is measured over: long enough
+# to smooth per-tick burstiness, short enough that Retry-After tracks the
+# CURRENT drain, not an idle hour ago
+DRAIN_WINDOW_S = 30.0
+
+
+def retry_after_s(pending: int, drain_rate: float | None, key: str,
+                  fallback: float = 1.0, max_s: float = 60.0) -> float:
+    """An HONEST Retry-After for a shed request: the measured time for
+    the `pending` requests ahead of it to drain at the current completion
+    rate, plus deterministic jitter (crc32 of the request key, up to 25%)
+    so synchronized clients do not retry in lockstep — same key, same
+    hint, across replicas and retries (salted hash() would differ per
+    process). Falls back to `fallback` before any completion has been
+    measured; clamped to [0.1, max_s]."""
+    if drain_rate is not None and drain_rate > 0:
+        base = (pending + 1) / drain_rate
+    else:
+        base = fallback
+    base = min(max(base, 0.1), max_s)
+    jitter = (zlib.crc32(key.encode()) % 1000) / 1000.0 * 0.25 * base
+    return round(min(base + jitter, max_s), 3)
 
 
 def percentile(values, q: float) -> float | None:
@@ -95,6 +121,9 @@ class SLOStats:
         self.ttft = collections.deque(maxlen=window)
         self.tpot = collections.deque(maxlen=window)
         self.queue_wait = collections.deque(maxlen=window)
+        # completion timestamps (monotonic): the admission drain-rate
+        # window behind every honest Retry-After (`retry_after_s`)
+        self.finished_at = collections.deque(maxlen=window)
         self.completed = 0
         self.rejected = 0
         self.failed = 0
@@ -109,12 +138,24 @@ class SLOStats:
             self.tokens_generated += tokens
             self.ttft.append(ttft)
             self.queue_wait.append(queue_wait)
+            self.finished_at.append(time.monotonic())
             if tpot is not None:
                 self.tpot.append(tpot)
 
     def record_rejected(self) -> None:
         with self._lock:
             self.rejected += 1
+
+    def drain_rate(self, window_s: float = DRAIN_WINDOW_S,
+                   now: float | None = None) -> float | None:
+        """Completions/sec over the trailing window (None before any
+        completion lands in it — absence of data must not fabricate a
+        rate; callers fall back to a static hint)."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            recent = sum(1 for t in self.finished_at if now - t <= window_s)
+        return recent / window_s if recent else None
 
     def record_failed(self) -> None:
         """Accepted but errored (admission/engine failure, not a client

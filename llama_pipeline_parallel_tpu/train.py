@@ -964,7 +964,8 @@ def _run_training(cfg: dict) -> dict:
                  extra_meta={"topology": topology,
                              "data_state": _data_state(step, loader,
                                                        len(dataset), seed,
-                                                       data_delta)})
+                                                       data_delta),
+                             **_eval_meta()})
 
     do_eval = _make_evaluator(cfg, mesh, model_cfg, pcfg, stacked_template,
                               attn_fn, lambda: state_box[0].params)
@@ -1389,6 +1390,14 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
     # every host (the decision must never depend on a host-local flag, or the
     # allgather call counts diverge and the pod hangs).
     check_every = max(int(cfg.get("preempt_check_every", 10)), 1)
+    # actions.resize_on_request (docs/RESILIENCE.md "Actuation"): poll for
+    # the autoscaler's resize.request on the same uniform cadence. The
+    # config is process-uniform, so the extra _should_stop allgather below
+    # is called identically everywhere — collective counts stay aligned.
+    from llama_pipeline_parallel_tpu.utils.actions import TrainActions
+
+    resize_watch = TrainActions.from_cfg(cfg.get("actions")).resize_on_request
+    _LAST_EVAL.clear()  # a fresh loop must not inherit a prior run's eval
     window_t0 = time.perf_counter()
     window_overhead = 0.0  # compile/eval/ckpt seconds to exclude from step_time
 
@@ -1425,12 +1434,22 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
             # behind preempt_notice would only be safe because the sync point
             # fires process-uniformly — keep the uniformity structural.
             stop_vote = check_now and _should_stop(bool(_STOP_SIGNALS))
-            if preempt_notice or stop_vote:
-                logger.warning("preemption signal; checkpointing at step %d and "
-                               "exiting for clean resume", step)
+            # the resize vote rides the same cadence and allgather shape:
+            # any process seeing the request stops ALL of them at this step
+            resize_vote = (resize_watch and check_now
+                           and _should_stop(_resize_requested(output_dir)))
+            if preempt_notice or stop_vote or resize_vote:
+                logger.warning("%s; checkpointing at step %d and "
+                               "exiting for clean resume",
+                               "resize request" if resize_vote
+                               else "preemption signal", step)
                 preempted_at = step
                 do_save(step, final=True)
                 last_saved = end_step  # suppress the save_final duplicate
+                if resize_vote and jax.process_index() == 0:
+                    # ack AFTER the save commits: the request must outlive
+                    # a crash-mid-save so the next incarnation re-honors it
+                    _ack_resize_request(output_dir)
                 break
             if profile_window and not trace_active and step >= profile_window[0] \
                     and step < profile_window[1]:
@@ -1523,6 +1542,8 @@ def _train_loop(cfg, model_cfg, mesh, loader, seq_length, resume_step, end_step,
                 with trace.span("eval", step=step + 1) as sp:
                     eval_loss = do_eval()
                 writer.log(step + 1, {"eval_loss": eval_loss})
+                # later checkpoints carry this as their deployment gate
+                _LAST_EVAL.update(step=step + 1, loss=float(eval_loss))
                 window_overhead += sp["dur"]
             if save_steps and (step + 1) % save_steps == 0:
                 t_save = time.perf_counter()
@@ -1613,6 +1634,50 @@ def _preemption_notice(step: int) -> bool:
     from jax.experimental import multihost_utils
 
     return bool(multihost_utils.reached_preemption_sync_point(step))
+
+
+# the most recent eval_loss, keyed into every later checkpoint's meta.json
+# (via do_save's extra_meta) — the continuous-deployment gate's input
+# (utils/actions.Deployer): a deploy/rollback decision needs the QUALITY of
+# a checkpoint, not just its existence. A module box, like _STOP_SIGNALS:
+# the eval happens in _train_loop but the save closures live in its callers.
+_LAST_EVAL: dict = {}
+
+
+def _eval_meta() -> dict:
+    """extra_meta contribution: the last eval_loss (and the step it was
+    measured at) — empty before the first eval so a never-evaluated run
+    writes no fabricated gate value."""
+    if "loss" in _LAST_EVAL:
+        return {"eval_loss": _LAST_EVAL["loss"],
+                "eval_step": _LAST_EVAL["step"]}
+    return {}
+
+
+def _resize_requested(output_dir: str) -> bool:
+    """Poll for an actuator's `resize.request` drop (utils/actions): the
+    fleet autoscaler asking this trainer to step down/up a ladder rung at
+    a step boundary instead of eating a SIGTERM mid-step."""
+    from llama_pipeline_parallel_tpu.utils.actions import RESIZE_REQUEST_NAME
+
+    return os.path.exists(os.path.join(output_dir, RESIZE_REQUEST_NAME))
+
+
+def _ack_resize_request(output_dir: str) -> None:
+    """Rename `resize.request` -> `resize.request.ack` (atomic on POSIX):
+    the actuator/test sees the trainer honored the request exactly once;
+    a crash before the rename leaves the request for the relaunched
+    incarnation — at-least-once, and the rename dedups."""
+    from llama_pipeline_parallel_tpu.utils.actions import (
+        RESIZE_ACK_NAME,
+        RESIZE_REQUEST_NAME,
+    )
+
+    try:
+        os.replace(os.path.join(output_dir, RESIZE_REQUEST_NAME),
+                   os.path.join(output_dir, RESIZE_ACK_NAME))
+    except OSError:
+        pass  # already acked by a peer process, or never landed locally
 
 
 def _should_stop(local_flag: bool) -> bool:
@@ -1857,7 +1922,8 @@ def _run_offload(cfg, mesh, model_cfg, manifest, pcfg, ocfg, dataset, collator,
                                             "data_state": _data_state(
                                                 step, loader, len(dataset),
                                                 cfg.get("seed", 42),
-                                                data_delta)})
+                                                data_delta),
+                                            **_eval_meta()})
         _sync_checkpoint(cfg, path)
 
     do_eval = _make_evaluator(cfg, mesh, model_cfg, pcfg, stacked_template,

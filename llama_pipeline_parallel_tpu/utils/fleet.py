@@ -236,21 +236,46 @@ def latest_verified_step(checkpoint_root: str) -> int | None:
 # ---------------------------------------------------------------------------
 
 ALERT_KEYS = {"heartbeat_stale_s", "goodput_floor", "step_time_p95_s",
-              "ttft_p95_ms", "checkpoint_lag_steps", "nonfinite_steps",
-              "oom_recent"}
+              "ttft_p95_ms", "queue_wait_p95_ms", "checkpoint_lag_steps",
+              "nonfinite_steps", "oom_recent"}
+# config key -> the rule name edges/status use (the `_s`/`_ms` unit
+# suffixes are config spelling, not alert identity)
+_RULE_NAMES = {"heartbeat_stale_s": "heartbeat_stale",
+               "goodput_floor": "goodput_floor",
+               "step_time_p95_s": "step_time_p95",
+               "ttft_p95_ms": "ttft_p95",
+               "queue_wait_p95_ms": "queue_wait_p95",
+               "checkpoint_lag_steps": "checkpoint_lag",
+               "nonfinite_steps": "nonfinite_steps",
+               "oom_recent": "oom_recent"}
+_INT_ALERT_KEYS = ("checkpoint_lag_steps", "nonfinite_steps", "oom_recent")
+# the dict spelling of one rule: {"threshold": 500, "for_s": 10,
+# "cooldown_s": 30} — flap damping without a second config surface
+_ALERT_VALUE_KEYS = {"threshold", "for_s", "cooldown_s"}
 
 
 @dataclasses.dataclass(frozen=True)
 class AlertRules:
     """Declarative fleet alert thresholds (unknown keys rejected, the
-    `offload.*` house style). None disables a rule. Semantics:
+    `offload.*` house style). None disables a rule. Each value is either
+    a bare threshold or `{"threshold": x, "for_s": y, "cooldown_s": z}` —
+    `for_s` requires the raw condition to hold continuously that long
+    before the alert FIRES (flap damping), and `cooldown_s` suppresses
+    re-firing for that long after a resolve (thrash damping). Both
+    default to 0, which is bit-identical to the undamped behavior.
+    Semantics:
 
     - heartbeat_stale_s: member heartbeat age (vouched by its latest
-      registry row, the supervisor's own staleness rule) above this fires.
+      registry row, the supervisor's own staleness rule) above this
+      fires. A member whose latest registry row is TERMINAL (the
+      supervisor wrote `outcome=aborted` on giving up) fires immediately
+      — a dead pod must not look healthy for the staleness window.
     - goodput_floor: a trainer/serve member's cumulative goodput BELOW
       this fires.
     - step_time_p95_s: the trainer's rolling step-time p95 above this.
     - ttft_p95_ms: a serve replica's rolling TTFT p95 above this.
+    - queue_wait_p95_ms: a serve replica's rolling queue-wait p95 above
+      this (admission latency — the autoscaler's primary borrow signal).
     - checkpoint_lag_steps: serve replica's loaded checkpoint step more
       than this many steps behind the trainer's latest verified one.
     - nonfinite_steps: more than this many nonfinite training steps
@@ -267,9 +292,12 @@ class AlertRules:
     goodput_floor: float | None = None
     step_time_p95_s: float | None = None
     ttft_p95_ms: float | None = None
+    queue_wait_p95_ms: float | None = None
     checkpoint_lag_steps: int | None = None
     nonfinite_steps: int | None = None
     oom_recent: int | None = None
+    # rule name -> (for_s, cooldown_s); absent = (0, 0)
+    damping: Any = None
 
     @classmethod
     def from_cfg(cls, node: Any) -> "AlertRules":
@@ -281,14 +309,40 @@ class AlertRules:
         if unknown:
             raise ValueError(f"unknown alerts.* key(s) {sorted(unknown)}; "
                              f"known: {sorted(ALERT_KEYS)}")
-        kw = {}
+        kw: dict[str, Any] = {}
+        damping: dict[str, tuple] = {}
         for key in ALERT_KEYS:
-            if node.get(key) is not None:
-                kw[key] = (int(node[key]) if key in
-                           ("checkpoint_lag_steps", "nonfinite_steps",
-                            "oom_recent")
-                           else float(node[key]))
+            raw = node.get(key)
+            if raw is None:
+                continue
+            if isinstance(raw, dict):
+                bad = set(raw) - _ALERT_VALUE_KEYS
+                if bad:
+                    raise ValueError(
+                        f"unknown alerts.{key} key(s) {sorted(bad)}; "
+                        f"known: {sorted(_ALERT_VALUE_KEYS)}")
+                if raw.get("threshold") is None:
+                    raise ValueError(f"alerts.{key} needs a 'threshold' "
+                                     f"when spelled as a mapping")
+                threshold = raw["threshold"]
+                for_s = float(raw.get("for_s", 0.0) or 0.0)
+                cooldown_s = float(raw.get("cooldown_s", 0.0) or 0.0)
+                if for_s < 0 or cooldown_s < 0:
+                    raise ValueError(f"alerts.{key}: for_s/cooldown_s "
+                                     f"must be >= 0")
+                if for_s or cooldown_s:
+                    damping[_RULE_NAMES[key]] = (for_s, cooldown_s)
+            else:
+                threshold = raw
+            kw[key] = (int(threshold) if key in _INT_ALERT_KEYS
+                       else float(threshold))
+        if damping:
+            kw["damping"] = damping
         return cls(**kw)
+
+    def damping_for(self, rule: str) -> tuple:
+        """(for_s, cooldown_s) for one rule name; (0, 0) when undamped."""
+        return (self.damping or {}).get(rule, (0.0, 0.0))
 
     def evaluate(self, member: dict) -> list[tuple[str, float, float, bool]]:
         """(rule, value, threshold, firing) for every rule whose input
@@ -303,9 +357,13 @@ class AlertRules:
                 out.append((name, value, threshold, bool(firing)))
 
         age = _num(member.get("heartbeat_age_s"))
+        # a terminal registration row (supervisor gave up: crash loop,
+        # exhausted budget, no rung) is an explicit death notice — stale
+        # NOW, not after the staleness window elapses past the abort
+        terminal = member.get("terminal_outcome") is not None
         rule("heartbeat_stale", age, self.heartbeat_stale_s,
              age is not None and self.heartbeat_stale_s is not None
-             and age > self.heartbeat_stale_s)
+             and (terminal or age > self.heartbeat_stale_s))
         if role != "supervisor":
             gp = _num(member.get("goodput"))
             rule("goodput_floor", gp, self.goodput_floor,
@@ -319,6 +377,10 @@ class AlertRules:
         rule("ttft_p95", ttft, self.ttft_p95_ms,
              ttft is not None and self.ttft_p95_ms is not None
              and ttft > self.ttft_p95_ms)
+        qw = _num(member.get("queue_wait_p95_ms"))
+        rule("queue_wait_p95", qw, self.queue_wait_p95_ms,
+             qw is not None and self.queue_wait_p95_ms is not None
+             and qw > self.queue_wait_p95_ms)
         lag = _num(member.get("checkpoint_lag"))
         rule("checkpoint_lag", lag, self.checkpoint_lag_steps,
              lag is not None and self.checkpoint_lag_steps is not None
@@ -504,6 +566,13 @@ class FleetAggregator:
         }
         if reg.get("layout") is not None:
             status["layout"] = reg.get("layout")
+        # a terminal registration row (register_member(..., outcome=...)
+        # when the supervisor gives up) stops this member counting as
+        # fresh: the heartbeat_stale rule fires immediately on it instead
+        # of waiting out the staleness window — a dead pod must not look
+        # healthy until its heartbeat ages out
+        if isinstance(reg.get("outcome"), str):
+            status["terminal_outcome"] = reg["outcome"]
         clock = health.get("clock")
         if isinstance(clock, dict):
             status["elapsed_s"] = _num(clock.get("elapsed"))
@@ -572,14 +641,33 @@ class FleetAggregator:
         edges: list[dict] = []
         for key, member in members.items():
             member_id = ids[key]
-            for rule, value, threshold, firing in self.rules.evaluate(member):
+            for rule, value, threshold, raw in self.rules.evaluate(member):
                 state_key = (rule,) + key
                 prev = self._alert_state.get(state_key)
                 if prev is None:
                     prev = self._alert_state[state_key] = {
-                        "firing": False, "since": now}
+                        "firing": False, "since": now,
+                        "raw_since": None, "resolved_at": None}
+                # flap damping (for_s / cooldown_s, AlertRules docstring):
+                # the raw condition must hold continuously for for_s before
+                # the alert FIRES, and a resolve suppresses re-firing for
+                # cooldown_s. Both default 0 — damped == raw, bit-identical
+                # to the undamped evaluator.
+                for_s, cooldown_s = self.rules.damping_for(rule)
+                if raw:
+                    if prev.get("raw_since") is None:
+                        prev["raw_since"] = now
+                else:
+                    prev["raw_since"] = None
+                firing = raw and now - prev["raw_since"] >= for_s
+                if firing and not prev["firing"] \
+                        and prev.get("resolved_at") is not None \
+                        and now - prev["resolved_at"] < cooldown_s:
+                    firing = False
                 transitioned = firing != prev["firing"]
                 if transitioned:
+                    if not firing:
+                        prev["resolved_at"] = now
                     prev["firing"] = firing
                     prev["since"] = now
                     edge = {"ts": now, "alert": rule, "member": member_id,

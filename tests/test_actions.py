@@ -1,0 +1,520 @@
+"""Alert-driven actuation (utils/actions.py + tools/fleetctl.py —
+docs/RESILIENCE.md "Actuation").
+
+Fast lanes: the action journal's intent/outcome pairing and id recovery,
+the actions.* config surface (unknown keys rejected), the autoscaler's
+borrow/handback hysteresis and cooldown, the deployer's tail / hold /
+rollback / lag-force gate, crash recovery (reconcile completes evidenced
+intents, safely voids unevidenced ones), the honest Retry-After formula's
+pinned values, reader degradation on torn/garbage actions.jsonl, and the
+inert-by-default pins. The whole-pod chaos e2e lives in
+test_actuation_e2e.py."""
+
+import json
+import os
+import time
+
+import pytest
+
+import fleetctl
+from llama_pipeline_parallel_tpu.serve.telemetry import retry_after_s
+from llama_pipeline_parallel_tpu.utils import actions, fleet
+from llama_pipeline_parallel_tpu.utils.actions import (
+    ActionJournal,
+    ActionsConfig,
+    Autoscaler,
+    AutoscaleConfig,
+    Deployer,
+    DeployConfig,
+    TrainActions,
+    read_actions,
+    reconcile_open_intents,
+    write_action_request,
+)
+
+
+def firing_status(rules, since, now=None):
+    """A minimal fleet_status.json payload with the given alerts firing."""
+    now = time.time() if now is None else now
+    return {"time": now, "members": {}, "pod": {},
+            "alerts": {f"{rule}:serve:r0": {"state": "firing",
+                                            "since": since,
+                                            "value": 1, "threshold": 0}
+                       for rule in rules}}
+
+
+def autoscaler(tmp_path, **kw):
+    root = str(tmp_path / "fleet")
+    trainer = str(tmp_path / "train")
+    cfg = AutoscaleConfig.from_cfg({"trainer_dir": trainer,
+                                    "borrow_rung": "half",
+                                    "restore_rung": "full", **kw})
+    return Autoscaler(cfg, ActionJournal(root), root), trainer
+
+
+def deployer(tmp_path, n_replicas=1, **kw):
+    root = str(tmp_path / "fleet")
+    trainer = str(tmp_path / "train")
+    replicas = [str(tmp_path / f"serve{i}") for i in range(n_replicas)]
+    for d in (trainer, *replicas):
+        os.makedirs(d, exist_ok=True)
+    cfg = DeployConfig.from_cfg({"trainer_dir": trainer,
+                                 "replica_dirs": replicas, **kw})
+    return Deployer(cfg, ActionJournal(root)), trainer, replicas
+
+
+def write_ckpt(trainer, step, eval_loss=None, complete=True):
+    d = os.path.join(trainer, f"checkpoint-{step}")
+    os.makedirs(d, exist_ok=True)
+    if complete:
+        meta = {"step": step}
+        if eval_loss is not None:
+            meta["eval_loss"] = eval_loss
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            json.dump(meta, f)
+
+
+def set_serving(replica_dir, step):
+    fleet.write_json_atomic(os.path.join(replica_dir, "serve.json"),
+                            {"pid": 1, "checkpoint_step": step})
+
+
+# ---------------------------------------------------------------------------
+# the journal
+# ---------------------------------------------------------------------------
+
+def test_journal_pairs_and_recovers_ids(tmp_path):
+    j = ActionJournal(str(tmp_path))
+    a = j.begin("borrow", params={"rung": "half"}, alert="ttft_p95:serve:r0")
+    assert a == "action-000000"
+    assert [r["id"] for r in j.open_intents()] == [a]
+    j.finish(a, "done", rung="half")
+    assert j.open_intents() == []
+    hist = j.history()
+    assert hist[0]["result"]["outcome"] == "done"
+    assert hist[0]["result"]["kind"] == "borrow"  # outcome carries the kind
+    assert hist[0]["alert"] == "ttft_p95:serve:r0"
+    # ids are recovered from the file, not memory: a NEW journal object
+    # (an actuator restart) continues the sequence
+    j2 = ActionJournal(str(tmp_path))
+    assert j2.begin("handback") == "action-000001"
+
+
+def test_journal_last_done_ts_ignores_voids(tmp_path):
+    j = ActionJournal(str(tmp_path))
+    a = j.begin("borrow")
+    j.finish(a, "done")
+    done_ts = j.history()[0]["result"]["ts"]
+    b = j.begin("borrow")
+    j.finish(b, "voided")
+    assert j.last_done_ts(("borrow", "handback")) == done_ts
+
+
+def test_journal_reader_degrades_on_torn_and_garbage(tmp_path):
+    j = ActionJournal(str(tmp_path))
+    a = j.begin("deploy", params={"step": 10})
+    j.finish(a, "done")
+    with open(j.path, "a") as f:
+        f.write("not json at all\n")
+        f.write('["a", "list", "row"]\n')
+        f.write('{"id": "action-000009", "phase": "intent"')  # torn tail
+    rows = read_actions(os.path.dirname(j.path))
+    assert [r["phase"] for r in rows] == ["intent", "outcome"]
+    assert ActionJournal(str(tmp_path)).next_id() == "action-000001"
+    assert read_actions(str(tmp_path / "nowhere")) == []
+
+
+# ---------------------------------------------------------------------------
+# the actions.* config surface
+# ---------------------------------------------------------------------------
+
+def test_actions_config_rejects_unknown_keys(tmp_path):
+    with pytest.raises(ValueError, match="unknown actions"):
+        ActionsConfig.from_cfg({"autoscaler": {}})  # typo'd block name
+    with pytest.raises(ValueError, match="unknown actions.autoscale"):
+        AutoscaleConfig.from_cfg({"trainer_dir": "t", "borrow_rung": "a",
+                                  "restore_rung": "b", "for_secs": 5})
+    with pytest.raises(ValueError, match="unknown actions.deploy"):
+        DeployConfig.from_cfg({"trainer_dir": "t", "replica_dirs": ["r"],
+                               "rollback": True})
+    with pytest.raises(ValueError, match="unknown actions"):
+        TrainActions.from_cfg({"resize": True})
+    with pytest.raises(ValueError, match="required"):
+        AutoscaleConfig.from_cfg({"trainer_dir": "t", "borrow_rung": "a"})
+    with pytest.raises(ValueError, match="must be >= 0"):
+        AutoscaleConfig.from_cfg({"trainer_dir": "t", "borrow_rung": "a",
+                                  "restore_rung": "b", "cooldown_s": -1})
+    with pytest.raises(ValueError, match="non-empty list"):
+        DeployConfig.from_cfg({"trainer_dir": "t", "replica_dirs": []})
+    # empty/None block -> inert config, no actuators
+    assert ActionsConfig.from_cfg(None) == ActionsConfig()
+    assert TrainActions.from_cfg(None).resize_on_request is False
+
+
+def test_fleetctl_parse_actions_inline_and_file(tmp_path):
+    spec = {"deploy": {"trainer_dir": str(tmp_path),
+                       "replica_dirs": [str(tmp_path / "r")]}}
+    cfg = fleetctl.parse_actions(json.dumps(spec))
+    assert cfg.deploy.trainer_dir == str(tmp_path)
+    assert cfg.autoscale is None
+    path = tmp_path / "actions.json"
+    path.write_text(json.dumps(spec))
+    assert fleetctl.parse_actions(f"@{path}") == cfg
+    assert fleetctl.parse_actions(None) == ActionsConfig()
+    with pytest.raises(ValueError):
+        fleetctl.parse_actions('{"bogus": 1}')
+
+
+# ---------------------------------------------------------------------------
+# the honest Retry-After
+# ---------------------------------------------------------------------------
+
+def test_retry_after_pinned_values():
+    """The formula is deterministic — same backlog, rate, and request key
+    give the SAME hint across processes and retries (crc32 jitter, not a
+    salted hash). Pinned so the contract cannot drift silently."""
+    # 4 ahead + self = 5 requests at 2/s -> 2.5s base; crc32("req-1") %
+    # 1000 = 545 -> jitter = 0.545 * 0.25 * 2.5
+    assert retry_after_s(4, 2.0, key="req-1") == 2.841
+    assert retry_after_s(4, 2.0, key="req-1") == 2.841  # deterministic
+    assert retry_after_s(4, 2.0, key="req-2") == 2.502  # key-dependent
+    # no measured rate yet -> the static fallback (plus jitter), never 0
+    assert retry_after_s(100, None, key="x", fallback=1.0) < 1.5
+    assert retry_after_s(0, 0.0, key="x", fallback=1.0) >= 0.1
+    # clamped: a dead-slow drain cannot tell a client to wait an hour
+    assert retry_after_s(10_000, 0.001, key="x", max_s=60.0) == 60.0
+
+
+# ---------------------------------------------------------------------------
+# the autoscaler state machine
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_borrows_on_sustained_breach_only(tmp_path):
+    scaler, trainer = autoscaler(tmp_path, for_s=10)
+    now = time.time()
+    # firing, but not for long enough -> hysteresis holds
+    assert scaler.tick(firing_status(["ttft_p95"], since=now - 3), now) == []
+    assert scaler.mode() == "normal"
+    # sustained past for_s -> borrow: intent row, request file, done row
+    taken = scaler.tick(firing_status(["ttft_p95"], since=now - 11), now)
+    assert len(taken) == 1
+    req = actions.read_json_file(
+        os.path.join(trainer, actions.ACTION_REQUEST_NAME))
+    assert req == {"ts": req["ts"], "action": "resize", "rung": "half",
+                   "id": taken[0]}
+    assert scaler.mode() == "borrowed"
+    hist = scaler.journal.history()
+    assert hist[0]["alert"] == "ttft_p95:serve:r0"
+    assert hist[0]["result"]["outcome"] == "done"
+    # borrowed + still breaching -> nothing further to take
+    assert scaler.tick(firing_status(["ttft_p95"], since=now - 20),
+                       now + 1) == []
+
+
+def test_autoscaler_handback_after_sustained_quiet(tmp_path):
+    scaler, trainer = autoscaler(tmp_path, idle_for_s=5)
+    now = time.time()
+    scaler.tick(firing_status(["queue_wait_p95"], since=now - 1), now)
+    assert scaler.mode() == "borrowed"
+    os.remove(os.path.join(trainer, actions.ACTION_REQUEST_NAME))
+    quiet = {"time": now, "alerts": {}}
+    assert scaler.tick(quiet, now + 10) == []      # quiet clock starts
+    assert scaler.tick(quiet, now + 12) == []      # 2s quiet < idle_for_s
+    # a breach mid-quiet resets the clock
+    scaler.tick(firing_status(["queue_wait_p95"], since=now + 13), now + 13)
+    assert scaler.tick(quiet, now + 14) == []
+    taken = scaler.tick(quiet, now + 20)           # 6s quiet -> handback
+    assert len(taken) == 1
+    req = actions.read_json_file(
+        os.path.join(trainer, actions.ACTION_REQUEST_NAME))
+    assert req["rung"] == "full"
+    assert scaler.mode() == "normal"
+
+
+def test_autoscaler_cooldown_rate_limits_transitions(tmp_path):
+    scaler, trainer = autoscaler(tmp_path, cooldown_s=30)
+    now = time.time()
+    scaler.tick(firing_status(["ttft_p95"], since=now - 1), now)
+    os.remove(os.path.join(trainer, actions.ACTION_REQUEST_NAME))
+    quiet = {"time": now, "alerts": {}}
+    assert scaler.tick(quiet, now + 5) == []    # quiet, but cooling down
+    assert scaler.tick(quiet, now + 29) == []
+    assert len(scaler.tick(quiet, now + 31)) == 1  # cooled -> handback
+
+
+def test_autoscaler_ignores_unconfigured_alerts(tmp_path):
+    scaler, _ = autoscaler(tmp_path, breach_alerts=["queue_wait_p95"])
+    now = time.time()
+    assert scaler.tick(firing_status(["ttft_p95", "checkpoint_lag"],
+                                     since=now - 100), now) == []
+    assert scaler.tick(None, now) == []  # no status snapshot yet
+
+
+# ---------------------------------------------------------------------------
+# the deployer gate
+# ---------------------------------------------------------------------------
+
+def test_deployer_tails_latest_verified_checkpoint(tmp_path):
+    dep, trainer, (replica,) = deployer(tmp_path)
+    assert dep.tick(None, time.time()) == []        # no checkpoints yet
+    write_ckpt(trainer, 10, eval_loss=2.0)
+    write_ckpt(trainer, 20, eval_loss=1.5)
+    write_ckpt(trainer, 30, complete=False)         # no meta -> not verified
+    taken = dep.tick(None, time.time())
+    assert len(taken) == 1
+    req = actions.read_json_file(
+        os.path.join(replica, actions.ACTION_REQUEST_NAME))
+    assert req["action"] == "deploy" and req["step"] == 20
+    # the request is still unconsumed -> no stacking
+    assert dep.tick(None, time.time()) == []
+    # consumed and serving 20 -> converged, nothing to do
+    os.remove(os.path.join(replica, actions.ACTION_REQUEST_NAME))
+    set_serving(replica, 20)
+    assert dep.tick(None, time.time()) == []
+
+
+def test_deployer_holds_regressed_candidate_once(tmp_path):
+    dep, trainer, (replica,) = deployer(tmp_path)
+    write_ckpt(trainer, 10, eval_loss=1.5)
+    write_ckpt(trainer, 20, eval_loss=1.9)          # regressed vs deployed
+    set_serving(replica, 10)
+    assert dep.tick(None, time.time()) == []
+    assert dep.tick(None, time.time()) == []
+    holds = [h for h in dep.journal.history() if h["kind"] == "hold"]
+    assert len(holds) == 1                          # journaled ONCE
+    assert holds[0]["params"]["step"] == 20
+    assert holds[0]["params"]["candidate_eval"] == 1.9
+    assert not os.path.exists(
+        os.path.join(replica, actions.ACTION_REQUEST_NAME))
+
+
+def test_deployer_rolls_back_deployed_regression(tmp_path):
+    dep, trainer, (replica,) = deployer(tmp_path)
+    write_ckpt(trainer, 10, eval_loss=1.5)
+    write_ckpt(trainer, 20, eval_loss=1.9)
+    set_serving(replica, 20)                        # the regression is LIVE
+    taken = dep.tick(None, time.time())
+    assert len(taken) == 1
+    req = actions.read_json_file(
+        os.path.join(replica, actions.ACTION_REQUEST_NAME))
+    assert req["step"] == 10                        # previous verified step
+    hist = dep.journal.history()
+    assert hist[-1]["kind"] == "rollback"
+    assert hist[-1]["params"]["reason"] == "eval_regression"
+
+
+def test_deployer_eval_regression_tolerance(tmp_path):
+    dep, trainer, (replica,) = deployer(tmp_path, eval_regression=0.5)
+    write_ckpt(trainer, 10, eval_loss=1.5)
+    write_ckpt(trainer, 20, eval_loss=1.9)          # within the 0.5 band
+    set_serving(replica, 10)
+    taken = dep.tick(None, time.time())             # tolerated -> deploys
+    assert len(taken) == 1
+    assert dep.journal.history()[-1]["kind"] == "deploy"
+
+
+def test_deployer_lag_alert_forces_handoff(tmp_path):
+    dep, trainer, (replica,) = deployer(tmp_path, cooldown_s=3600)
+    write_ckpt(trainer, 10, eval_loss=1.5)
+    write_ckpt(trainer, 20, eval_loss=1.9)          # regressed AND cooling
+    set_serving(replica, 10)
+    now = time.time()
+    lag = firing_status(["checkpoint_lag"], since=now - 1, now=now)
+    taken = dep.tick(lag, now)                      # forced past both gates
+    assert len(taken) == 1
+    hist = dep.journal.history()
+    assert hist[-1]["params"]["reason"] == "lag_alert"
+    assert hist[-1]["alert"] == "checkpoint_lag:serve:r0"
+    req = actions.read_json_file(
+        os.path.join(replica, actions.ACTION_REQUEST_NAME))
+    assert req["step"] == 20
+
+
+def test_deployer_on_lag_alert_false_keeps_the_gate(tmp_path):
+    dep, trainer, (replica,) = deployer(tmp_path, on_lag_alert=False)
+    write_ckpt(trainer, 10, eval_loss=1.5)
+    write_ckpt(trainer, 20, eval_loss=1.9)
+    set_serving(replica, 10)
+    now = time.time()
+    assert dep.tick(firing_status(["checkpoint_lag"], since=now - 1,
+                                  now=now), now) == []
+
+
+# ---------------------------------------------------------------------------
+# crash recovery: reconcile the open intents
+# ---------------------------------------------------------------------------
+
+def test_reconcile_voids_unevidenced_borrow(tmp_path):
+    """Killed between the intent row and the request write: the world is
+    unchanged, so the intent is safely VOIDED — the still-firing alert
+    re-triggers a fresh action (and the void does not consume cooldown)."""
+    scaler, trainer = autoscaler(tmp_path, cooldown_s=3600)
+    a = scaler.journal.begin("borrow", params={"rung": "half"})
+    resolved = reconcile_open_intents(scaler.journal, scaler, None)
+    assert resolved == [(a, "borrow", "voided")]
+    assert scaler.journal.open_intents() == []
+    now = time.time()
+    # the void consumed no cooldown: the breach re-triggers immediately
+    taken = scaler.tick(firing_status(["ttft_p95"], since=now - 1), now)
+    assert len(taken) == 1
+
+
+def test_reconcile_completes_evidenced_borrow(tmp_path):
+    """Killed between the request write and the outcome row: the request
+    (or the supervisor's ack of it) is the delivery evidence — the intent
+    COMPLETES as done instead of double-firing."""
+    scaler, trainer = autoscaler(tmp_path)
+    a = scaler.journal.begin("borrow", params={"rung": "half"})
+    write_action_request(trainer, {"action": "resize", "rung": "half",
+                                   "id": a})
+    assert reconcile_open_intents(scaler.journal, scaler,
+                                  None) == [(a, "borrow", "done")]
+    row = scaler.journal.history()[0]["result"]
+    assert row["evidence"] == "request_pending" and row["reconciled"]
+    assert scaler.mode() == "borrowed"
+    # same, with the request already consumed into the supervisor's ack
+    b = scaler.journal.begin("handback", params={"rung": "full"})
+    os.replace(os.path.join(trainer, actions.ACTION_REQUEST_NAME),
+               os.path.join(trainer, actions.ACTION_ACK_NAME))
+    fleet.write_json_atomic(
+        os.path.join(trainer, actions.ACTION_ACK_NAME),
+        {"id": b, "action": "resize"})
+    assert scaler.reconcile(scaler.journal.open_intents()[0]) == "done"
+    assert scaler.mode() == "normal"
+
+
+def test_reconcile_redelivers_open_deploy(tmp_path):
+    """Deploy is idempotent (the request names an absolute step), so an
+    unevidenced open deploy intent RE-DELIVERS and completes."""
+    dep, trainer, (replica,) = deployer(tmp_path)
+    a = dep.journal.begin("deploy", params={"replica_dir": replica,
+                                            "step": 20})
+    assert reconcile_open_intents(dep.journal, None,
+                                  dep) == [(a, "deploy", "done")]
+    req = actions.read_json_file(
+        os.path.join(replica, actions.ACTION_REQUEST_NAME))
+    assert req["step"] == 20 and req["id"] == a
+    # already serving the target -> evidence enough, no re-delivery
+    os.remove(os.path.join(replica, actions.ACTION_REQUEST_NAME))
+    b = dep.journal.begin("deploy", params={"replica_dir": replica,
+                                            "step": 30})
+    set_serving(replica, 30)
+    assert dep.reconcile(dep.journal.open_intents()[0]) == "done"
+    assert not os.path.exists(
+        os.path.join(replica, actions.ACTION_REQUEST_NAME))
+    # malformed params can only void
+    c = dep.journal.begin("rollback", params={"replica_dir": None})
+    assert dep.reconcile(dep.journal.open_intents()[0]) == "voided"
+
+
+def test_reconcile_voids_unowned_kinds(tmp_path):
+    j = ActionJournal(str(tmp_path))
+    a = j.begin("deploy", params={"replica_dir": "/x", "step": 1})
+    assert reconcile_open_intents(j, None, None) == [(a, "deploy", "voided")]
+
+
+# ---------------------------------------------------------------------------
+# tools/fleetctl.py end to end (in-process and CLI)
+# ---------------------------------------------------------------------------
+
+def test_fleetctl_actuator_reads_status_and_acts(tmp_path):
+    root = str(tmp_path / "fleet")
+    trainer = str(tmp_path / "train")
+    os.makedirs(root, exist_ok=True)
+    now = time.time()
+    fleet.write_json_atomic(os.path.join(root, fleet.STATUS_NAME),
+                            firing_status(["ttft_p95"], since=now - 60))
+    act = fleetctl.FleetActuator(root, fleetctl.parse_actions(json.dumps({
+        "autoscale": {"trainer_dir": trainer, "borrow_rung": "half",
+                      "restore_rung": "full", "for_s": 5}})))
+    assert act.reconcile() == []
+    taken = act.tick()
+    assert len(taken) == 1
+    assert actions.read_json_file(
+        os.path.join(trainer, actions.ACTION_REQUEST_NAME))["rung"] == "half"
+
+
+def test_fleetctl_once_cli(tmp_path, capsys):
+    root = str(tmp_path / "fleet")
+    os.makedirs(root, exist_ok=True)
+    # an open intent from a "killed" predecessor reconciles at startup
+    ActionJournal(root).begin("borrow", params={"rung": "half"})
+    spec = json.dumps({"autoscale": {"trainer_dir": str(tmp_path / "t"),
+                                     "borrow_rung": "half",
+                                     "restore_rung": "full"}})
+    assert fleetctl.main(["--fleet-root", root, "--actions", spec,
+                          "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "reconciled action-000000 (borrow): voided" in out
+    assert json.loads(out.strip().splitlines()[-1]) == {"actions": []}
+    with pytest.raises(SystemExit, match="bad --actions"):
+        fleetctl.main(["--fleet-root", root, "--actions", '{"bogus": 1}',
+                       "--once"])
+
+
+def test_fleetctl_inert_without_actions(tmp_path, capsys):
+    """No --actions -> no actuators, no journal writes, no request files:
+    the inert-by-default pin."""
+    root = str(tmp_path / "fleet")
+    now = time.time()
+    os.makedirs(root, exist_ok=True)
+    fleet.write_json_atomic(os.path.join(root, fleet.STATUS_NAME),
+                            firing_status(["ttft_p95"], since=now - 3600))
+    assert fleetctl.main(["--fleet-root", root, "--once"]) == 0
+    assert json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1]) == {"actions": []}
+    assert not os.path.exists(os.path.join(root, actions.ACTIONS_NAME))
+
+
+# ---------------------------------------------------------------------------
+# the report timeline
+# ---------------------------------------------------------------------------
+
+def test_fleet_report_interleaves_actions_with_alert_edges(tmp_path, capsys):
+    import fleet_report
+
+    root = str(tmp_path / "fleet")
+    os.makedirs(root, exist_ok=True)
+    t0 = 1000.0
+    with open(os.path.join(root, fleet.ALERTS_NAME), "a") as f:
+        f.write(json.dumps({"ts": t0, "alert": "ttft_p95",
+                            "member": "serve:r0", "state": "firing",
+                            "value": 900, "threshold": 500}) + "\n")
+        f.write(json.dumps({"ts": t0 + 30, "alert": "ttft_p95",
+                            "member": "serve:r0", "state": "resolved",
+                            "value": 100, "threshold": 500}) + "\n")
+    with open(os.path.join(root, actions.ACTIONS_NAME), "a") as f:
+        f.write(json.dumps({"ts": t0 + 10, "id": "action-000000",
+                            "kind": "borrow", "phase": "intent",
+                            "params": {"rung": "half"},
+                            "alert": "ttft_p95:serve:r0"}) + "\n")
+        f.write(json.dumps({"ts": t0 + 11, "id": "action-000000",
+                            "kind": "borrow", "phase": "outcome",
+                            "outcome": "done"}) + "\n")
+        f.write("garbage line\n")                      # reader degrades
+    rep = fleet_report.build_report(root)
+    assert [r["id"] for r in rep["action_timeline"]] == ["action-000000"] * 2
+    fleet_report.print_report(rep)
+    out = capsys.readouterr().out
+    assert "actions timeline (interleaved with alert edges)" in out
+    section = out[out.index("actions timeline"):].splitlines()
+    lines = [ln for ln in section if ln.strip().startswith("t+")]
+    # merged clock: firing edge, then the intent it caused, its outcome,
+    # then the resolve
+    assert "FIRING" in lines[0]
+    assert "INTENT" in lines[1] and "<- ttft_p95:serve:r0" in lines[1]
+    assert "DONE" in lines[2]
+    assert "RESOLVED" in lines[3]
+
+
+def test_fleet_report_without_actions_is_unchanged(tmp_path, capsys):
+    """No actions.jsonl -> no actions section at all (inertness: the
+    report reads byte-identically to a pre-actuation pod)."""
+    import fleet_report
+
+    root = str(tmp_path / "fleet")
+    os.makedirs(root, exist_ok=True)
+    rep = fleet_report.build_report(root)
+    assert rep["action_timeline"] == []
+    fleet_report.print_report(rep)
+    assert "actions timeline" not in capsys.readouterr().out

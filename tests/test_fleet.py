@@ -655,3 +655,134 @@ def test_serving_report_surfaces_breach_and_refusal_counters(tmp_path,
     out = capsys.readouterr().out
     assert "slo_breaches=3" in out and "requests_page_refused=5" in out
     assert "requests_failed=1" in out and "prefill_chunks_total=2" in out
+
+
+# ---------------------------------------------------------------------------
+# alert damping (for_s / cooldown_s) — independent of any actuator
+# ---------------------------------------------------------------------------
+
+def test_alert_damping_config_parse_and_reject():
+    rules = AlertRules.from_cfg({
+        "ttft_p95_ms": {"threshold": 500, "for_s": 10, "cooldown_s": 30},
+        "heartbeat_stale_s": 30})
+    assert rules.ttft_p95_ms == 500.0
+    assert rules.damping_for("ttft_p95") == (10.0, 30.0)
+    assert rules.damping_for("heartbeat_stale") == (0.0, 0.0)
+    # scalar spelling == dict spelling with zero damping
+    assert AlertRules.from_cfg({"ttft_p95_ms": 500}) == \
+        AlertRules.from_cfg({"ttft_p95_ms": {"threshold": 500}})
+    with pytest.raises(ValueError, match="unknown alerts.ttft_p95_ms"):
+        AlertRules.from_cfg({"ttft_p95_ms": {"threshold": 500, "hold_s": 9}})
+    with pytest.raises(ValueError, match="threshold"):
+        AlertRules.from_cfg({"ttft_p95_ms": {"for_s": 10}})
+    with pytest.raises(ValueError, match=">= 0"):
+        AlertRules.from_cfg({"ttft_p95_ms": {"threshold": 5, "for_s": -1}})
+
+
+def _eval_once(agg, value, now):
+    """One damped-evaluator pass over a single synthetic serve member."""
+    key = ("serve", "/runs/s0")
+    member = {"role": "serve", "replica": "s0", "output_dir": "/runs/s0",
+              "ttft_p95_ms": value}
+    return agg._evaluate_alerts({key: member}, {key: "serve:s0"}, now,
+                                write=False)
+
+
+def test_alert_for_s_delays_the_rising_edge(tmp_path):
+    root = str(tmp_path / "fleet")
+    os.makedirs(root)
+    rules = AlertRules.from_cfg({"ttft_p95_ms": {"threshold": 500,
+                                                 "for_s": 10}})
+    agg = FleetAggregator(root, rules)
+    t0 = time.time()
+    # breaching, but not sustained -> no edge yet
+    alerts, edges = _eval_once(agg, 900, t0)
+    assert edges == [] and alerts == {}
+    alerts, edges = _eval_once(agg, 900, t0 + 5)
+    assert edges == []
+    # a dip resets the continuity clock
+    _eval_once(agg, 100, t0 + 6)
+    alerts, edges = _eval_once(agg, 900, t0 + 7)
+    assert edges == []
+    alerts, edges = _eval_once(agg, 900, t0 + 16)   # held 9s < 10s
+    assert edges == []
+    alerts, edges = _eval_once(agg, 900, t0 + 17.5)  # held 10.5s -> FIRES
+    assert [e["state"] for e in edges] == ["firing"]
+    assert alerts["ttft_p95:serve:s0"]["state"] == "firing"
+
+
+def test_alert_cooldown_suppresses_the_refire(tmp_path):
+    root = str(tmp_path / "fleet")
+    os.makedirs(root)
+    rules = AlertRules.from_cfg({"ttft_p95_ms": {"threshold": 500,
+                                                 "cooldown_s": 30}})
+    agg = FleetAggregator(root, rules)
+    t0 = time.time()
+    _, edges = _eval_once(agg, 900, t0)              # for_s=0: fires at once
+    assert [e["state"] for e in edges] == ["firing"]
+    _, edges = _eval_once(agg, 100, t0 + 1)          # resolves
+    assert [e["state"] for e in edges] == ["resolved"]
+    _, edges = _eval_once(agg, 900, t0 + 5)          # flap inside cooldown
+    assert edges == []
+    _, edges = _eval_once(agg, 900, t0 + 29)
+    assert edges == []
+    _, edges = _eval_once(agg, 900, t0 + 32)         # cooled -> re-fires
+    assert [e["state"] for e in edges] == ["firing"]
+
+
+def test_zero_damping_is_bit_identical_to_undamped(tmp_path):
+    """{threshold: x} with no for_s/cooldown_s must produce the exact
+    edge sequence the scalar spelling always did."""
+    t0 = time.time()
+    seqs = []
+    for spec in (500, {"threshold": 500}):
+        root = str(tmp_path / f"fleet-{len(seqs)}")
+        os.makedirs(root)
+        agg = FleetAggregator(root, AlertRules.from_cfg(
+            {"ttft_p95_ms": spec}))
+        seq = []
+        for dt, val in ((0, 900), (1, 900), (2, 100), (3, 900)):
+            _, edges = _eval_once(agg, val, t0 + dt)
+            seq.extend((round(e["ts"] - t0, 3), e["state"]) for e in edges)
+        seqs.append(seq)
+    assert seqs[0] == seqs[1]
+    assert [s for _, s in seqs[0]] == ["firing", "resolved", "firing"]
+
+
+def test_queue_wait_p95_rule_fires(tmp_path):
+    root = str(tmp_path / "fleet")
+    os.makedirs(root)
+    now = time.time()
+    make_member(root, tmp_path, "s0", role="serve",
+                health={"time": now, "role": "serve"},
+                metrics=[{"step": 1, "serving": 1,
+                          "queue_wait_p95_ms": 850.0}])
+    agg = FleetAggregator(root, AlertRules.from_cfg(
+        {"queue_wait_p95_ms": 500}))
+    status = agg.refresh()
+    assert status["members"]["serve:s0"]["queue_wait_p95_ms"] == 850.0
+    assert status["pod"]["alerts_firing"] == ["queue_wait_p95:serve:s0"]
+
+
+def test_terminal_registry_row_fires_stale_immediately(tmp_path):
+    """A supervisor that gave up writes outcome=aborted registry rows;
+    the member must alert NOW — a fresh-looking abort row must not vouch
+    liveness for the whole staleness window."""
+    root = str(tmp_path / "fleet")
+    os.makedirs(root)
+    now = time.time()
+    out = make_member(root, tmp_path, "t0", role="trainer",
+                      health={"time": now}, reg_ts=now)
+    agg = FleetAggregator(root, AlertRules(heartbeat_stale_s=30.0))
+    assert agg.refresh()["pod"]["alerts_firing"] == []
+    register_member(root, output_dir=out, role="trainer", pid=99,
+                    incarnation=3, outcome="aborted", reason="crash_loop")
+    status = agg.refresh()
+    assert status["members"]["trainer:t0"]["terminal_outcome"] == "aborted"
+    assert status["pod"]["alerts_firing"] == ["heartbeat_stale:trainer:t0"]
+    # a relaunch re-registers WITHOUT an outcome -> fresh again, resolves
+    register_member(root, output_dir=out, role="trainer", pid=100,
+                    incarnation=4)
+    status = agg.refresh()
+    assert status["pod"]["alerts_firing"] == []
+    assert "terminal_outcome" not in status["members"]["trainer:t0"]

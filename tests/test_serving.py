@@ -558,3 +558,67 @@ def test_multi_replica_supervised_restart(setup, tmp_path):
         rows_b = [json.loads(l) for l in f]
     assert [r["outcome"] for r in rows_b] == ["clean"]
     assert rows_b[0]["role"] == "serve"
+
+
+# -- degraded-mode admission (docs/RESILIENCE.md "Actuation") ----------------
+
+
+def test_degraded_mode_sheds_and_recovers(setup):
+    """A degraded engine (draining / mid-resize) refuses NEW admissions
+    with an honest retry hint, keeps decoding what it already admitted,
+    advertises the reason in its metrics, and recovers the moment the
+    degradation clears."""
+    cfg, params = setup
+    engine = make_engine(cfg, params)
+    gen = GenerationConfig(max_new_tokens=3)
+    h = engine.submit(ServeRequest(input_ids=[5, 6], gen=gen, seed=1))
+    engine.set_degraded("draining")
+    with pytest.raises(ServeOverloaded) as exc:
+        engine.submit(ServeRequest(input_ids=[7, 8], gen=gen))
+    assert "degraded (draining)" in str(exc.value)
+    assert exc.value.retry_after_s > 0
+    assert engine.metrics_snapshot()["degraded"] == "draining"
+    # the admitted request still decodes through the degraded window
+    engine.drain(timeout_s=120)
+    assert h.result(timeout=1) == reference_tokens(params, cfg, [5, 6],
+                                                   gen, 1)
+    engine.clear_degraded()
+    assert "degraded" not in engine.metrics_snapshot()
+    h2 = engine.submit(ServeRequest(input_ids=[7, 8], gen=gen, seed=2))
+    engine.drain(timeout_s=120)
+    assert h2.result(timeout=1) == reference_tokens(params, cfg, [7, 8],
+                                                    gen, 2)
+
+
+def test_degraded_maps_to_429_with_pinned_retry_after(setup):
+    """HTTP contract pin: a degraded replica answers 429 with a
+    Retry-After measured from its OWN backlog and drain rate. 2 queued
+    requests draining at 1 completion / 30 s window -> 90 s, clamped to
+    the 60 s cap — jitter cannot move a clamped value, so the header is
+    exactly "60" for any request id."""
+    from llama_pipeline_parallel_tpu.serve.frontend import make_server
+
+    cfg, params = setup
+    engine = make_engine(cfg, params)
+    server = make_server(engine)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    gen = GenerationConfig(max_new_tokens=2)
+    try:
+        for i in range(2):
+            engine.submit(ServeRequest(input_ids=[5, 6], gen=gen, seed=i))
+        engine.stats.finished_at.append(time.monotonic())
+        engine.set_degraded("draining")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/generate",
+                data=json.dumps({"input_ids": [3, 4],
+                                 "max_new_tokens": 2}).encode(),
+                headers={"Content-Type": "application/json"}), timeout=60)
+        assert err.value.code == 429
+        assert err.value.headers["Retry-After"] == "60"
+        assert "degraded (draining)" in json.loads(err.value.read())["error"]
+    finally:
+        engine.clear_degraded()
+        engine.drain(timeout_s=120)  # the queued admissions still finish
+        server.shutdown()

@@ -121,3 +121,160 @@ def test_cli_runs_command_after_separator(tmp_path):
                           "--"] + py("pass"))
     assert rc == 0
     assert ledger(tmp_path)[0]["outcome"] == "clean"
+
+
+# ---------------------------------------------------------------------------
+# actuation (--actuate): the action.request RPC from tools/fleetctl.py
+# ---------------------------------------------------------------------------
+
+def _actions():
+    from llama_pipeline_parallel_tpu.utils import actions
+
+    return actions
+
+
+def _write_request(out, payload):
+    with open(os.path.join(str(out), _actions().ACTION_REQUEST_NAME),
+              "w") as f:
+        json.dump(payload, f)
+
+
+def test_actuate_resize_pins_rung_and_persists(tmp_path, monkeypatch):
+    """A pre-launch resize request pins the named ladder rung (overriding
+    best-fit), drops the trainer-visible resize.request, writes the ack +
+    action_state.json, removes the request — and a FRESH Supervisor over
+    the same output_dir reloads the pin."""
+    actions = _actions()
+    monkeypatch.setenv("LPT_DEVICE_COUNT", "8")
+    argv_log = str(tmp_path / "argv.jsonl")
+    ladder = supervisor.parse_ladder(json.dumps([
+        {"name": "full", "devices": 8, "overrides": ["mesh.dp=2"]},
+        {"name": "half", "devices": 4, "overrides": ["mesh.dp=1"]}]))
+    _write_request(tmp_path, {"action": "resize", "rung": "half",
+                              "id": "action-000004"})
+    child = (f"import json, sys\n"
+             f"open({argv_log!r}, 'a').write(json.dumps(sys.argv[1:]))\n")
+    rc = Supervisor(py(child),
+                    fast_cfg(tmp_path, ladder=ladder, actuate=True)).run()
+    assert rc == 0
+    # the pin beat best-fit: 8 devices available, half rung launched
+    assert json.loads(open(argv_log).read()) == ["mesh.dp=1"]
+    rows = ledger(tmp_path)
+    assert [r["layout"] for r in rows] == ["half"]
+    # every on-disk artifact of the RPC, in its final state
+    assert not os.path.exists(
+        os.path.join(str(tmp_path), actions.ACTION_REQUEST_NAME))
+    resize = json.load(open(
+        os.path.join(str(tmp_path), actions.RESIZE_REQUEST_NAME)))
+    assert resize["rung"] == "half" and resize["id"] == "action-000004"
+    ack = json.load(open(
+        os.path.join(str(tmp_path), actions.ACTION_ACK_NAME)))
+    assert ack["id"] == "action-000004" and ack["action"] == "resize"
+    state = json.load(open(
+        os.path.join(str(tmp_path), supervisor.ACTION_STATE_NAME)))
+    assert state["rung"] == "half" and state["last_id"] == "action-000004"
+    # a supervisor RESTART (fresh object, same dir) keeps honoring the pin
+    sup2 = Supervisor(py("pass"),
+                      fast_cfg(tmp_path, ladder=ladder, actuate=True))
+    assert sup2._pinned_rung == "half"
+    # ... but only under --actuate: the pin never leaks into a plain run
+    sup3 = Supervisor(py("pass"), fast_cfg(tmp_path, ladder=ladder))
+    assert sup3._pinned_rung is None
+
+
+def test_actuate_deploy_restarts_child_with_step_override(tmp_path):
+    """A deploy request that lands while the child is RUNNING: the child is
+    gracefully stopped, its clean exit continues supervision (restart
+    boundary, not the end), and the next incarnation gets `--step N`
+    spliced in — replacing any existing --step."""
+    actions = _actions()
+    argv_log = str(tmp_path / "argv.jsonl")
+    marker = str(tmp_path / "first.marker")
+    req = json.dumps({"action": "deploy", "step": 7, "id": "action-000002"})
+    req_path = os.path.join(str(tmp_path), actions.ACTION_REQUEST_NAME)
+    child = (
+        f"import json, os, signal, sys\n"
+        f"open({argv_log!r}, 'a').write(json.dumps(sys.argv[1:]) + '\\n')\n"
+        f"if os.path.exists({marker!r}):\n"
+        f"    sys.exit(0)\n"
+        f"open({marker!r}, 'w').close()\n"
+        f"signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))\n"
+        f"os.replace({marker!r} + '.tmp', {req_path!r})\n"
+        f"signal.pause()\n")
+    with open(marker + ".tmp", "w") as f:
+        f.write(req)
+    rc = Supervisor(py(child) + ["--step", "1"],
+                    fast_cfg(tmp_path, actuate=True, max_restarts=3)).run()
+    assert rc == 0
+    argvs = [json.loads(l) for l in open(argv_log)]
+    assert argvs[0] == ["--step", "1"]
+    assert argvs[1] == ["--step", "7"]          # replaced, not appended
+    rows = ledger(tmp_path)
+    assert [r["outcome"] for r in rows] == ["clean", "clean"]
+    # the ledger says WHY incarnation 0 ended: the applied action
+    assert rows[0]["action"] == {"id": "action-000002", "action": "deploy"}
+    assert "action" not in rows[1]
+    assert json.load(open(os.path.join(
+        str(tmp_path), supervisor.ACTION_STATE_NAME)))["step"] == 7
+
+
+def test_actuate_off_leaves_requests_untouched(tmp_path):
+    """Inert by default: without --actuate an action.request is never read,
+    never removed, and no actuation artifact appears."""
+    actions = _actions()
+    _write_request(tmp_path, {"action": "resize", "rung": "half",
+                              "id": "action-000000"})
+    rc = Supervisor(py("pass"), fast_cfg(tmp_path)).run()
+    assert rc == 0
+    assert os.path.exists(
+        os.path.join(str(tmp_path), actions.ACTION_REQUEST_NAME))
+    for leftover in (actions.ACTION_ACK_NAME, actions.RESIZE_REQUEST_NAME,
+                     supervisor.ACTION_STATE_NAME):
+        assert not os.path.exists(os.path.join(str(tmp_path), leftover))
+    assert "action" not in ledger(tmp_path)[0]
+
+
+def test_actuate_degrades_on_bad_requests(tmp_path):
+    """Torn, unknown-action, and step-less deploy requests are removed and
+    ignored (never a traceback, never a wedged skip-if-present writer)."""
+    actions = _actions()
+    req_path = os.path.join(str(tmp_path), actions.ACTION_REQUEST_NAME)
+    for bad in ('{"torn',
+                json.dumps({"action": "defrag", "id": "action-000001"}),
+                json.dumps({"action": "deploy", "step": "latest"})):
+        with open(req_path, "w") as f:
+            f.write(bad)
+        rc = Supervisor(py("pass"), fast_cfg(tmp_path, actuate=True)).run()
+        assert rc == 0
+        assert not os.path.exists(req_path)
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), actions.ACTION_ACK_NAME))
+
+
+def test_abort_writes_terminal_registry_rows(tmp_path):
+    """Crash-loop / budget / no-rung give-ups write outcome=aborted registry
+    rows for BOTH member keys (child + supervisor), so the aggregator stops
+    counting a pod nothing will restart as merely quiet."""
+    from llama_pipeline_parallel_tpu.utils import fleet
+
+    fleet_root = str(tmp_path / "fleet")
+    rc = Supervisor(py("import sys; sys.exit(1)"),
+                    fast_cfg(tmp_path / "run", max_restarts=50,
+                             crash_loop_threshold=2,
+                             crash_loop_window_s=100.0,
+                             fleet_root=fleet_root, role="trainer")).run()
+    assert rc == 3
+    rows = [r for r in fleet.load_registry(fleet_root)
+            if r.get("outcome") == "aborted"]
+    assert {r.get("role") for r in rows} == {"trainer", "supervisor"}
+    assert all(r["reason"] == "crash_loop" for r in rows)
+    # budget exhaustion aborts too, with its own reason
+    fleet_root2 = str(tmp_path / "fleet2")
+    rc = Supervisor(py("import sys; sys.exit(1)"),
+                    fast_cfg(tmp_path / "run2", max_restarts=0,
+                             crash_loop_threshold=9,
+                             fleet_root=fleet_root2, role="trainer")).run()
+    assert rc == 2
+    reasons = {r["reason"] for r in fleet.load_registry(fleet_root2)
+               if r.get("outcome") == "aborted"}
+    assert reasons == {"budget_exhausted"}

@@ -250,6 +250,15 @@ def test_eval_loop(tmp_path, devices):
              open(os.path.join(summary["output_dir"], "metrics.jsonl"))]
     evals = [l for l in lines if "eval_loss" in l]
     assert len(evals) == 2 and all(np.isfinite(l["eval_loss"]) for l in evals)
+    # the LAST eval lands in the final checkpoint's meta.json — the quality
+    # signal the continuous-deployment gate (utils/actions.Deployer) reads
+    from llama_pipeline_parallel_tpu.utils.actions import checkpoint_eval_loss
+
+    meta = json.load(open(os.path.join(summary["output_dir"],
+                                       "checkpoint-4", "meta.json")))
+    assert meta["eval_loss"] == evals[-1]["eval_loss"]
+    assert meta["eval_step"] == 4
+    assert checkpoint_eval_loss(summary["output_dir"], 4) == meta["eval_loss"]
 
 
 def test_shipped_configs_parse():
@@ -277,3 +286,62 @@ def test_shipped_configs_parse():
         assert mc.vocab_size % tp == 0, path
         assert cfg.get("max_seq_length", 512) % sp == 0, path
         assert mc.num_hidden_layers >= mesh.get("pp", 1), path
+
+
+def test_resize_request_checkpoints_acks_and_exits(tmp_path, devices):
+    """actions.resize_on_request: a `resize.request` dropped into
+    output_dir (the supervisor's actuation RPC) stops the loop at the next
+    step boundary — checkpoint saved, THEN the request renamed to
+    `resize.request.ack` (ack-after-save: a crash mid-save leaves the
+    request for the next incarnation), clean exit."""
+    import threading
+    import time as _time
+
+    from llama_pipeline_parallel_tpu.utils.actions import (
+        RESIZE_ACK_NAME,
+        RESIZE_REQUEST_NAME,
+    )
+
+    out = str(tmp_path / "out")
+    req = os.path.join(out, RESIZE_REQUEST_NAME)
+
+    def drop_once_running():
+        deadline = _time.time() + 120
+        metrics = os.path.join(out, "metrics.jsonl")
+        while _time.time() < deadline and not os.path.exists(metrics):
+            _time.sleep(0.05)
+        with open(req + ".tmp", "w") as f:
+            json.dump({"rung": "half", "id": "action-000000"}, f)
+        os.replace(req + ".tmp", req)
+
+    t = threading.Thread(target=drop_once_running)
+    t.start()
+    try:
+        summary = run_training(base_cfg(
+            tmp_path, max_steps=60, logging_steps=1,
+            actions={"resize_on_request": True}))
+    finally:
+        t.join()
+    assert summary["preempted_at"] is not None
+    assert summary["preempted_at"] < 60
+    step = summary["final_step"]
+    assert os.path.isdir(os.path.join(out, f"checkpoint-{step}"))
+    assert not os.path.exists(req)
+    ack = json.load(open(os.path.join(out, RESIZE_ACK_NAME)))
+    assert ack["rung"] == "half"
+
+
+def test_resize_request_inert_without_actions_block(tmp_path, devices):
+    """No `actions` config -> the trainer never reads resize.request: the
+    run completes untouched and the file survives (actuation is opt-in at
+    every layer)."""
+    from llama_pipeline_parallel_tpu.utils.actions import RESIZE_REQUEST_NAME
+
+    out = tmp_path / "out"
+    out.mkdir()
+    req = os.path.join(str(out), RESIZE_REQUEST_NAME)
+    with open(req, "w") as f:
+        json.dump({"rung": "half"}, f)
+    summary = run_training(base_cfg(tmp_path))
+    assert summary["preempted_at"] is None and summary["final_step"] == 4
+    assert os.path.exists(req)  # nobody consumed it

@@ -90,7 +90,11 @@ def assert_tree_bitexact(a, b):
     (2, 2, 4),                  # the dryrun_multichip acceptance grid
     pytest.param(4, 2, 4, marks=pytest.mark.slow),
     pytest.param(2, 4, 4, marks=pytest.mark.slow),   # deeper interleaving
-    (4, 1, 2),                  # M < S: the pipe never fills
+    # M < S masking: slow since PR 17 (actuation rebalance) — the regime
+    # keeps a fast rep in test_interleaved_v1_degenerates_to_flat[4-2]
+    # through the same unit interpreter; the zb1-specific B/W split stays
+    # gated fast by the (2, 2, 4) row above
+    pytest.param(4, 1, 2, marks=pytest.mark.slow),
     pytest.param(4, 1, 1, marks=pytest.mark.slow),   # M == 1
     pytest.param(4, 2, 8, marks=pytest.mark.slow),
 ])

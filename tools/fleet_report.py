@@ -26,6 +26,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from llama_pipeline_parallel_tpu.utils.actions import (  # noqa: E402
+    read_actions,
+)
 from llama_pipeline_parallel_tpu.utils.fleet import (  # noqa: E402
     HEALTH_NAME,
     FleetAggregator,
@@ -78,9 +81,12 @@ def build_report(fleet_root: str) -> dict:
     events.sort(key=lambda e: e["start"] or 0.0)
 
     alerts = read_alerts(fleet_root)
+    actions = read_actions(fleet_root)
     t0_candidates = ([e["start"] for e in events if e["start"]]
                      + [_num(a.get("ts")) for a in alerts
                         if _num(a.get("ts"))]
+                     + [_num(r.get("ts")) for r in actions
+                        if _num(r.get("ts"))]
                      + [_num(r.get("ts")) for r in registry
                         if _num(r.get("ts"))])
     t0 = min(t0_candidates) if t0_candidates else None
@@ -104,6 +110,7 @@ def build_report(fleet_root: str) -> dict:
             "registered_dirs": seen_dirs,
             "members": status["members"], "pod": status.get("pod", {}),
             "incarnation_timeline": events, "alert_timeline": alerts,
+            "action_timeline": actions,
             "slo_table": slo_rows,
             "checkpoint_lag": {"trainer_step": trainer_step,
                                "replicas": lag_rows}}
@@ -163,6 +170,31 @@ def print_report(rep: dict) -> None:
                   f"{str(a.get('state', '?')).upper():<9} {a.get('alert')} "
                   f"on {a.get('member')} (value={a.get('value')} "
                   f"threshold={a.get('threshold')})")
+
+    if rep.get("action_timeline"):
+        # the actuation story (tools/fleetctl.py): every action's intent
+        # and outcome row, interleaved with the alert edges that caused
+        # them — one merged clock, so cause sits right above effect
+        print("\n== actions timeline (interleaved with alert edges) ==")
+        merged = ([("alert", _num(a.get("ts")), a)
+                   for a in rep["alert_timeline"]]
+                  + [("action", _num(r.get("ts")), r)
+                     for r in rep["action_timeline"]])
+        merged.sort(key=lambda item: item[1] or 0.0)
+        for tag, ts, row in merged:
+            if tag == "alert":
+                print(f"  {_rel(ts, t0)}  alert  "
+                      f"{str(row.get('state', '?')).upper():<9} "
+                      f"{row.get('alert')} on {row.get('member')}")
+            elif row.get("phase") == "intent":
+                cause = f"  <- {row['alert']}" if row.get("alert") else ""
+                print(f"  {_rel(ts, t0)}  action INTENT    "
+                      f"{row.get('kind')} {row.get('id')} "
+                      f"params={row.get('params')}{cause}")
+            else:
+                print(f"  {_rel(ts, t0)}  action "
+                      f"{str(row.get('outcome', '?')).upper():<9} "
+                      f"{row.get('kind')} {row.get('id')}")
 
     if rep["slo_table"]:
         print("\n== serve tier SLOs (last metrics line per replica) ==")

@@ -39,9 +39,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from llama_pipeline_parallel_tpu.utils.actions import (  # noqa: E402
+    ACTIONS_NAME,
+)
 from llama_pipeline_parallel_tpu.utils.fleet import (  # noqa: E402
     AlertRules,
     FleetAggregator,
+    JsonlTailer,
 )
 
 
@@ -163,6 +167,10 @@ def main(argv: list[str] | None = None) -> int:
         except ValueError:  # not the main thread (in-process tests)
             pass
 
+    # tools/fleetctl.py's action journal, echoed next to the alert edges
+    # that caused the actions — the pod's incident AND response timeline in
+    # one log. No actuator running -> no file -> poll() is a no-op.
+    actions_tail = JsonlTailer(os.path.join(args.fleet_root, ACTIONS_NAME))
     try:
         while not stop.is_set():
             with server.status_lock:  # type: ignore[attr-defined]
@@ -172,6 +180,16 @@ def main(argv: list[str] | None = None) -> int:
                       f"{edge['alert']} on {edge['member']} "
                       f"(value={edge['value']} threshold={edge['threshold']})",
                       flush=True)
+            for row in actions_tail.poll():
+                if row.get("phase") == "intent":
+                    print(f"[fleetd] action INTENT: {row.get('kind')} "
+                          f"{row.get('id')} params={row.get('params')}"
+                          + (f" alert={row['alert']}"
+                             if row.get("alert") else ""), flush=True)
+                elif row.get("phase") == "outcome":
+                    print(f"[fleetd] action "
+                          f"{str(row.get('outcome', '?')).upper()}: "
+                          f"{row.get('kind')} {row.get('id')}", flush=True)
             stop.wait(args.refresh_s)
     finally:
         server.shutdown()

@@ -245,10 +245,12 @@ def main(argv: list[str] | None = None) -> int:
                 # capture would trace nothing, unbounded, until traffic)
                 prof.observe_step(engine.steps)
                 engine._work.wait(args.idle_poll_s)
-        # graceful drain: no new connections, finish what's in flight —
-        # the documented stop contract; whatever outlives the window is
-        # failed by engine.shutdown() below
-        server.shutdown()
+        # graceful drain: HTTP stays UP but every new submit sheds with a
+        # coherent 429 + honest Retry-After (degraded-mode admission,
+        # docs/RESILIENCE.md "Actuation") while in-flight and queued
+        # requests finish — the stop contract; whatever outlives the
+        # window is failed by engine.shutdown() below
+        engine.set_degraded("draining")
         deadline = time.monotonic() + args.drain_s
         while ((engine.slots.active_count or engine.queue_depth())
                and time.monotonic() < deadline):

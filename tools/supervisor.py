@@ -94,6 +94,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 LEDGER_NAME = "incarnations.jsonl"
 HEALTH_NAME = "health.json"
+# actuation state persisted across SUPERVISOR restarts (pinned ladder
+# rung / pinned serve checkpoint step): a restarted watchdog must keep
+# honoring an applied action, not silently revert the pod
+ACTION_STATE_NAME = "action_state.json"
 
 
 def _now() -> float:
@@ -170,6 +174,13 @@ class SupervisorConfig:
     fleet_root: str | None = None
     role: str | None = None
     replica: str | None = None
+    # alert-driven actuation (docs/RESILIENCE.md "Actuation"): consume
+    # atomic `action.request` files an actuator (tools/fleetctl.py) drops
+    # into output_dir — resize pins a ladder rung, deploy pins a serve
+    # checkpoint step; both gracefully restart the child WITHOUT ending
+    # supervision. Off by default: without it the supervisor's behavior
+    # is byte-identical to the pre-actuation watchdog.
+    actuate: bool = False
 
 
 class Supervisor:
@@ -198,6 +209,22 @@ class Supervisor:
         self._hb_state: dict[str, Any] = {
             "incarnation": None, "child_pid": None, "restarts": 0,
             "consecutive_failures": 0, "last_outcome": None, "layout": None}
+        # actuation (--actuate): pinned layout rung / serve checkpoint
+        # step, persisted in action_state.json so a supervisor restart
+        # keeps honoring an applied action; the action currently stopping
+        # the child (its clean exit must NOT end supervision)
+        self._pinned_rung: str | None = None
+        self._pinned_step: int | None = None
+        self._action_pending: dict | None = None
+        self._action_state_path = os.path.join(cfg.output_dir,
+                                               ACTION_STATE_NAME)
+        if cfg.actuate:
+            state = self._read_json(self._action_state_path)
+            if state:
+                if isinstance(state.get("rung"), str):
+                    self._pinned_rung = state["rung"]
+                if isinstance(state.get("step"), int):
+                    self._pinned_step = state["step"]
 
     def _heartbeat_start(self) -> None:
         try:
@@ -252,6 +279,151 @@ class Supervisor:
             # the restart loop
             print(f"[supervisor] fleet registration failed: {e!r}",
                   flush=True)
+
+    def _register_abort(self, reason: str) -> None:
+        """Terminal registry rows for BOTH member keys (child + the
+        supervisor's own) when supervision gives up (crash loop, budget,
+        no rung): the aggregator stops counting them as fresh the moment
+        it reads the row, instead of waiting out heartbeat_stale_s on a
+        pod nothing will ever restart."""
+        if not self.cfg.fleet_root:
+            return
+        try:
+            from llama_pipeline_parallel_tpu.utils import fleet
+
+            fleet.register_member(
+                self.cfg.fleet_root, output_dir=self.cfg.output_dir,
+                role=self.cfg.role, replica=self.cfg.replica,
+                pid=os.getpid(), outcome="aborted", reason=reason)
+            fleet.register_member(
+                self.cfg.fleet_root, output_dir=self.cfg.output_dir,
+                role="supervisor", replica=self.cfg.replica,
+                pid=os.getpid(), health_file=fleet.SUPERVISOR_HEALTH_NAME,
+                outcome="aborted", reason=reason)
+        except Exception as e:  # telemetry; the exit code must still land
+            print(f"[supervisor] abort registration failed: {e!r}",
+                  flush=True)
+
+    # -- actuation (--actuate) -----------------------------------------------
+
+    @staticmethod
+    def _read_json(path: str) -> dict | None:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return data if isinstance(data, dict) else None
+
+    @staticmethod
+    def _write_json_atomic(path: str, payload: dict) -> None:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=2)
+        os.replace(tmp, path)
+
+    def _apply_step_override(self, cmd: list[str]) -> list[str]:
+        """The pinned serve checkpoint step, spliced into the child
+        command (any existing --step is replaced — the pin IS the
+        deployment state)."""
+        if self._pinned_step is None:
+            return cmd
+        out, i = [], 0
+        while i < len(cmd):
+            if cmd[i] == "--step" and i + 1 < len(cmd):
+                i += 2
+                continue
+            if cmd[i].startswith("--step="):
+                i += 1
+                continue
+            out.append(cmd[i])
+            i += 1
+        return out + ["--step", str(self._pinned_step)]
+
+    def _consume_action_request(self, child: "subprocess.Popen | None"
+                                ) -> dict | None:
+        """One actuation RPC step: read + validate `action.request`,
+        apply the pin, persist it, write the ack (the actuator's
+        reconciliation evidence), remove the request, then gracefully
+        stop the child (it saves/drains and exits 0 — the same contract
+        as preemption). Crash-safe at every seam: the request file is
+        removed LAST, so a supervisor killed mid-apply re-consumes an
+        identical request on restart (pins are idempotent)."""
+        from llama_pipeline_parallel_tpu.utils import actions
+
+        req_path = os.path.join(self.cfg.output_dir,
+                                actions.ACTION_REQUEST_NAME)
+        req = self._read_json(req_path)
+        if req is None:
+            if os.path.exists(req_path):
+                # torn/garbage request: drop it, or it wedges the
+                # actuator's skip-if-present writer forever
+                print(f"[supervisor] removing unreadable action request "
+                      f"{req_path}", flush=True)
+                try:
+                    os.remove(req_path)
+                except OSError:
+                    pass
+            return None
+        action = req.get("action")
+        if action == "resize":
+            rung = req.get("rung")
+            self._pinned_rung = rung if isinstance(rung, str) else None
+            # the trainer's own boundary poll (actions.resize_on_request):
+            # a labeled resize file it consumes at the next step boundary
+            # — the SIGTERM below covers children that don't poll
+            try:
+                self._write_json_atomic(
+                    os.path.join(self.cfg.output_dir,
+                                 actions.RESIZE_REQUEST_NAME),
+                    {"ts": _now(), "id": req.get("id"),
+                     "rung": self._pinned_rung})
+            except OSError:
+                pass
+        elif action == "deploy":
+            try:
+                self._pinned_step = int(req["step"])
+            except (KeyError, TypeError, ValueError):
+                print(f"[supervisor] deploy request without a valid step: "
+                      f"{req!r}; ignoring", flush=True)
+                try:
+                    os.remove(req_path)
+                except OSError:
+                    pass
+                return None
+        else:
+            print(f"[supervisor] unknown action {action!r}; ignoring",
+                  flush=True)
+            try:
+                os.remove(req_path)
+            except OSError:
+                pass
+            return None
+        try:
+            self._write_json_atomic(
+                self._action_state_path,
+                {"rung": self._pinned_rung, "step": self._pinned_step,
+                 "last_id": req.get("id"), "ts": _now()})
+            self._write_json_atomic(
+                os.path.join(self.cfg.output_dir, actions.ACTION_ACK_NAME),
+                {"ts": _now(), "id": req.get("id"), "action": action,
+                 "rung": self._pinned_rung, "step": self._pinned_step})
+        except OSError as e:
+            print(f"[supervisor] could not persist action state: {e!r}",
+                  flush=True)
+        try:
+            os.remove(req_path)
+        except OSError:
+            pass
+        print(f"[supervisor] action {req.get('id')}: {action} "
+              f"(rung={self._pinned_rung} step={self._pinned_step}); "
+              f"restarting child gracefully", flush=True)
+        if child is not None and child.poll() is None:
+            try:
+                child.terminate()  # trainer saves at a boundary, serve
+            except OSError:        # drains — both exit 0
+                pass
+        return req
 
     def _last_ledger_layout(self) -> str | None:
         try:
@@ -328,6 +500,16 @@ class Supervisor:
         if not self.cfg.ladder:
             return None, None
         available = self._probe_devices(incarnation)
+        if self._pinned_rung is not None:
+            for rung in self.cfg.ladder:
+                if rung.label() == self._pinned_rung:
+                    # an applied resize action overrides best-fit: a
+                    # BORROW deliberately runs a smaller rung than the
+                    # probe would pick (the freed devices host a serve
+                    # replica), so availability does not re-promote it
+                    return rung, available
+            print(f"[supervisor] pinned rung {self._pinned_rung!r} not in "
+                  f"the ladder; falling back to best-fit", flush=True)
         for rung in self.cfg.ladder:
             if available is None or available >= rung.devices:
                 return rung, available
@@ -394,6 +576,9 @@ class Supervisor:
                 elif rc != 0:
                     outcome = "crash"
                 break
+            if self.cfg.actuate and self._stop_signal is None \
+                    and self._action_pending is None:
+                self._action_pending = self._consume_action_request(child)
             if self._stop_signal is None \
                     and self._heartbeat_age(started) > self.cfg.hang_timeout_s:
                 print(f"[supervisor] incarnation {incarnation} heartbeat "
@@ -447,6 +632,12 @@ class Supervisor:
             rec["role"] = health.get("role")
         if layout is not None:
             rec.update(layout)
+        if self._action_pending is not None:
+            # the ledger shows WHY this incarnation ended: an applied
+            # action, not a fault (outcome stays "clean" so goodput and
+            # crash-loop accounting are untouched)
+            rec["action"] = {"id": self._action_pending.get("id"),
+                             "action": self._action_pending.get("action")}
         self._log_incarnation(rec)
         print(f"[supervisor] incarnation {incarnation} ended: "
               f"outcome={outcome} exit={rc} last_step={rec['last_step']}",
@@ -466,6 +657,12 @@ class Supervisor:
         try:
             failures: list[dict] = []  # consecutive non-clean incarnations
             for incarnation in range(self.cfg.max_restarts + 1):
+                if self.cfg.actuate and self._action_pending is None:
+                    # a request that arrived while no child was running
+                    # (or survived a supervisor crash mid-apply): apply
+                    # the pin BEFORE launching, then clear — there is no
+                    # child to stop, so it is not a pending restart
+                    self._consume_action_request(None)
                 rung, available = self._select_rung(incarnation)
                 cmd, layout = self.cmd, None
                 if self.cfg.ladder:
@@ -474,6 +671,7 @@ class Supervisor:
                               f"{available} available device(s); aborting "
                               f"(a layout the hardware cannot hold would "
                               f"only crash-loop)", flush=True)
+                        self._register_abort("no_rung_fits")
                         return 4
                     cmd = self.cmd + list(rung.overrides)
                     resized = (self._last_layout is not None
@@ -487,6 +685,7 @@ class Supervisor:
                               "overrides": list(rung.overrides),
                               "resized": resized}
                     self._last_layout = rung.label()
+                cmd = self._apply_step_override(cmd)
                 rec = self._run_once(incarnation, cmd=cmd, layout=layout)
                 self._hb_state.update(
                     last_outcome=rec["outcome"], restarts=incarnation,
@@ -494,6 +693,14 @@ class Supervisor:
                         0 if rec["outcome"] in ("clean", "supervisor_stopped")
                         else self._hb_state["consecutive_failures"] + 1))
                 if rec["outcome"] == "clean":
+                    if self._action_pending is not None:
+                        # an applied action stopped the child (resize/
+                        # deploy): its clean exit is a RESTART boundary,
+                        # not the end of supervision — relaunch on the
+                        # pinned state
+                        self._action_pending = None
+                        failures.clear()
+                        continue
                     return 0
                 if rec["outcome"] == "supervisor_stopped":
                     # pod preemption of the supervisor itself: the child was
@@ -512,9 +719,11 @@ class Supervisor:
                           f"incarnations each died within "
                           f"{self.cfg.crash_loop_window_s:.0f}s; giving up",
                           flush=True)
+                    self._register_abort("crash_loop")
                     return 3
             print(f"[supervisor] restart budget exhausted "
                   f"({self.cfg.max_restarts} restarts)", flush=True)
+            self._register_abort("budget_exhausted")
             return 2
         finally:
             if self._hb is not None:
@@ -567,6 +776,15 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--replica", default=None,
                    help="registry replica id; default: the output dir's "
                         "basename")
+    p.add_argument("--actuate", action="store_true",
+                   help="consume atomic action.request files an actuator "
+                        "(tools/fleetctl.py) drops into the output dir: "
+                        "resize pins a ladder rung, deploy pins a serve "
+                        "checkpoint step; both restart the child "
+                        "gracefully without ending supervision "
+                        "(docs/RESILIENCE.md 'Actuation'). Off by "
+                        "default — without it behavior is identical to "
+                        "the plain watchdog")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="the training command, after `--`")
     args = p.parse_args(argv)
@@ -579,7 +797,8 @@ def main(argv: list[str] | None = None) -> int:
         crash_loop_threshold=args.crash_loop_threshold,
         crash_loop_window_s=args.crash_loop_window_s, poll_s=args.poll_s,
         ladder=parse_ladder(args.layout_ladder), probe_cmd=args.probe_cmd,
-        fleet_root=args.fleet_root, role=args.role, replica=args.replica))
+        fleet_root=args.fleet_root, role=args.role, replica=args.replica,
+        actuate=args.actuate))
     return sup.run()
 
 
