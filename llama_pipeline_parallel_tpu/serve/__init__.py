@@ -5,9 +5,11 @@ one-shot batches to a long-lived service — slot-managed static KV cache
 (slots.py) or the paged KV cache (pages.py: fixed-size pages + slot->page
 table, so HBM tracks tokens actually generated; optional int8 pages),
 admission scheduler with continuous batching and chunked batched prefill
-(engine.py), SLO telemetry (telemetry.py), and a stdlib HTTP front-end
-(frontend.py). `tools/serve.py` wraps it into a supervised process;
+(engine.py), SLO telemetry (telemetry.py), per-request distributed
+tracing (reqtrace.py), and a stdlib HTTP front-end (frontend.py).
+`tools/serve.py` wraps it into a supervised process;
 `tools/serving_report.py` summarizes its telemetry offline;
+`tools/request_report.py` renders per-request waterfalls;
 `tools/serve_traffic.py` generates synthetic Poisson traffic against it.
 """
 
@@ -23,11 +25,16 @@ from llama_pipeline_parallel_tpu.serve.engine import (
     ServeRequest,
 )
 from llama_pipeline_parallel_tpu.serve.pages import PagedKVCache
+from llama_pipeline_parallel_tpu.serve.reqtrace import (
+    RequestTraceRecorder,
+    TraceContext,
+)
 from llama_pipeline_parallel_tpu.serve.slots import SlotKVCache
 from llama_pipeline_parallel_tpu.serve.telemetry import SLOStats
 
 __all__ = [
     "EngineShutdown", "PagedKVCache", "RequestHandle", "RequestRejected",
-    "ServeConfig", "ServeEngine", "ServeLoop", "ServeOverloaded",
-    "ServePagesExhausted", "ServeRequest", "SlotKVCache", "SLOStats",
+    "RequestTraceRecorder", "ServeConfig", "ServeEngine", "ServeLoop",
+    "ServeOverloaded", "ServePagesExhausted", "ServeRequest", "SlotKVCache",
+    "SLOStats", "TraceContext",
 ]

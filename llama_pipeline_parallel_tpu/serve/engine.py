@@ -58,6 +58,7 @@ from llama_pipeline_parallel_tpu.models.llama import decode
 from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
 from llama_pipeline_parallel_tpu.models.llama.decode import GenerationConfig
 from llama_pipeline_parallel_tpu.serve.pages import PagedKVCache
+from llama_pipeline_parallel_tpu.serve.reqtrace import TraceContext
 from llama_pipeline_parallel_tpu.serve.slots import SlotKVCache
 from llama_pipeline_parallel_tpu.serve.telemetry import SLOStats, retry_after_s
 from llama_pipeline_parallel_tpu.utils import trace
@@ -196,6 +197,14 @@ class ServeRequest:
     request_id: str = dataclasses.field(
         default_factory=lambda: f"req-{next(_REQUEST_IDS)}")
     arrival: float = dataclasses.field(default_factory=time.time)
+    # SLO class for per-tenant attribution (telemetry.SLOStats `tenants`
+    # map, fleet rollup, request traces); None = unattributed
+    tenant: str | None = None
+    # W3C trace context (serve/reqtrace.TraceContext): the frontend parses
+    # an incoming `traceparent` header into one; `submit()` mints one when
+    # absent, so every submitted request has a trace id whether or not a
+    # RequestTraceRecorder is attached
+    trace: TraceContext | None = None
 
 
 class RequestHandle:
@@ -286,7 +295,7 @@ class _Prefilling:
 class ServeEngine:
     def __init__(self, params: dict, cfg: LlamaConfig, serve_cfg: ServeConfig,
                  metrics_writer=None, timeline=None, profiler=None,
-                 slo=None):
+                 slo=None, reqtrace=None):
         """`params` in the CANONICAL (unstacked) layout —
         `ckpt.load_module_checkpoint` hands them out straight from any
         training checkpoint (the train->serve handoff).
@@ -298,7 +307,11 @@ class ServeEngine:
         per-segment timeline. `slo` (telemetry.SLOThresholds) checks every
         completed request; a breach bumps `slo_breaches` and fires
         `profiler` (utils/profiler.TriggeredProfiler), whose bounded
-        capture window advances one tick per `step()`."""
+        capture window advances one tick per `step()`. `reqtrace` (a
+        reqtrace.RequestTraceRecorder) turns on the request observatory:
+        one span tree per request written to request_trace.jsonl at
+        completion (docs/SERVING.md "Request tracing"); None (the
+        default) keeps every per-token path free of tracing work."""
         self.params = params
         self.cfg = cfg
         self.serve_cfg = serve_cfg
@@ -316,6 +329,13 @@ class ServeEngine:
         self._timeline = timeline
         self._profiler = profiler
         self._slo = slo
+        self._reqtrace = reqtrace
+        # request_id -> in-flight RequestTraceBuilder (loop thread only;
+        # empty forever when tracing is OFF — the structural free-ness pin)
+        self._rt: dict = {}
+        if reqtrace is not None and self._paged:
+            # attribute page-pool hand-outs to the owning slot's request
+            self.slots.alloc_listener = self._on_page_alloc
         self._last_decode_dur = 0.0
         self._occupants: dict[int, _Running] = {}
         self._prefilling: deque = deque()   # paged chunked admissions
@@ -384,6 +404,8 @@ class ServeEngine:
         queue full — shed load upstream). Both count as rejections in the
         SLO stats — an operator watching `requests_rejected` must see a
         storm of unservable shapes as clearly as queue overload."""
+        if request.trace is None:
+            request.trace = TraceContext.mint()
         demand = 0
         try:
             if len(request.input_ids) == 0:
@@ -399,7 +421,8 @@ class ServeEngine:
                         f"pool ({self.slots.num_pages} pages of "
                         f"{self.slots.page_size} tokens)")
         except RequestRejected:
-            self.stats.record_rejected()
+            self.stats.record_rejected(request.tenant)
+            self._record_shed(request, "rejected")
             raise
         handle = RequestHandle(request)
         with self._lock:
@@ -409,34 +432,62 @@ class ServeEngine:
                 # shed, don't queue: this process is draining/mid-resize;
                 # the honest hint covers the time to finish what it WILL
                 # serve (a relaunched replica is up well within it)
-                self.stats.record_rejected()
+                self.stats.record_rejected(request.tenant)
                 exc = ServeOverloaded(
                     f"degraded ({self._degraded}) — retry on this or "
                     f"another replica")
                 exc.retry_after_s = self._retry_after(request)
+                self._record_shed(request, f"degraded:{self._degraded}",
+                                  exc.retry_after_s)
                 raise exc
             if len(self._queue) >= self.serve_cfg.max_queue:
-                self.stats.record_rejected()
+                self.stats.record_rejected(request.tenant)
                 exc = ServeOverloaded(
                     f"wait queue full ({self.serve_cfg.max_queue})")
                 # honest backpressure: the measured time for the backlog
                 # ahead to drain, not a static hint
                 exc.retry_after_s = self._retry_after(request)
+                self._record_shed(request, "queue_full", exc.retry_after_s)
                 raise exc
             if demand and not self.slots.reserve(demand):
                 # refuse NOW: admitting would strand the request mid-decode
                 # when the pool runs dry under it
-                self.stats.record_rejected()
+                self.stats.record_rejected(request.tenant)
                 self.stats.record_page_refused()
+                retry = self._retry_after(request)
+                self._record_shed(request, "pages_exhausted", retry)
                 raise ServePagesExhausted(
                     f"free-page pool cannot cover the worst-case demand of "
                     f"{demand} pages ({self.slots.pages_free} free, "
                     f"{self.slots.pages_reserved}/{self.slots.num_pages} "
                     f"reserved) — retry after a request completes",
-                    retry_after_s=self._retry_after(request))
+                    retry_after_s=retry)
             self._queue.append((request, handle, demand))
         self._work.set()
         return handle
+
+    def _record_shed(self, request: ServeRequest, reason: str,
+                     retry_after_s: float | None = None) -> None:
+        """A rejection's terminal trace record (request-rate, any thread;
+        no-op with tracing OFF)."""
+        if self._reqtrace is not None:
+            self._reqtrace.record_shed(request, reason, retry_after_s)
+
+    def note_abandoned(self, request: ServeRequest) -> None:
+        """The frontend observed a client disconnect mid-stream. The
+        request keeps decoding to completion — there is no cancellation
+        protocol yet (docs/SERVING.md documents the gap) — so this only
+        bumps `requests_abandoned` and stamps a terminal `abandoned`
+        event on the request's trace (best-effort: a disconnect racing
+        the final completion write may land as a separate late record)."""
+        self.stats.record_abandoned(request.tenant)
+        if self._reqtrace is None:
+            return
+        b = self._rt.get(request.request_id)
+        if b is not None:
+            b.mark_abandoned(time.time())
+        else:
+            self._reqtrace.record_abandoned_late(request)
 
     # -- scheduling (the loop thread) -------------------------------------
 
@@ -529,9 +580,15 @@ class ServeEngine:
             except Exception as e:
                 logger.exception("prefill of %s failed",
                                  pf.request.request_id)
-                self.stats.record_failed()
+                self.stats.record_failed(pf.request.tenant)
                 self._prefilling.remove(pf)
                 self.slots.release(pf.slot)
+                if self._reqtrace is not None:
+                    b = self._rt.pop(pf.request.request_id, None)
+                    if b is not None:
+                        self._reqtrace.write(b.build(
+                            "failed", time.time(),
+                            tokens=len(pf.handle.tokens_out)))
                 pf.handle._finish(e)
                 continue
             spent += cost
@@ -552,10 +609,10 @@ class ServeEngine:
             if slot is None:
                 return None
             self._queue.popleft()
-        return request, handle, slot
+        return request, handle, slot, demand
 
     def _start_prefill(self, request: ServeRequest, handle: RequestHandle,
-                       slot: int) -> "_Prefilling | None":
+                       slot: int, demand: int) -> "_Prefilling | None":
         try:
             gen = request.gen
             t_admit = time.time()
@@ -575,13 +632,19 @@ class ServeEngine:
             if self._paged and chunk and bucket > chunk:
                 # incremental writes: the previous occupant's mask must die
                 self.slots.reset_mask_row(slot)
+            if self._reqtrace is not None:
+                b = self._reqtrace.begin(request)
+                b.admitted(t_admit, slot, bucket, demand)
+                self._rt[request.request_id] = b
             return _Prefilling(request=request, handle=handle, slot=slot,
                                bucket=bucket, ids=ids, mask=mask,
                                positions=positions, done=0, t_admit=t_admit)
         except Exception as e:
             logger.exception("admission of %s failed", request.request_id)
-            self.stats.record_failed()
+            self.stats.record_failed(request.tenant)
             self.slots.release(slot)
+            self._rt.pop(request.request_id, None)
+            self._record_shed(request, "admission_failed")
             handle._finish(e)
             return None
 
@@ -591,9 +654,10 @@ class ServeEngine:
         program and rng discipline as the dense admission) and join the
         decode batch. Returns True when the request finished prefilling."""
         slot = pf.slot
+        offset0 = pf.done
         with trace.span("serve_prefill", request=pf.request.request_id,
                         bucket=pf.bucket, slot=slot, chunk=cost,
-                        offset=pf.done):
+                        offset=pf.done) as sp:
             if cost == pf.bucket:
                 # single shot; the prefill logits depend only on the prompt
                 # block, so the row capacity (dense: the whole max_len row
@@ -622,23 +686,34 @@ class ServeEngine:
                 logits = out["logits"]
                 next_pos = int(pf.positions[0, -1]) + 1
                 pf.done = c1
-            if pf.done < pf.bucket:
-                return False
-            gen = pf.request.gen
-            chain, first_key = jax.random.split(
-                jax.random.PRNGKey(pf.request.seed))
-            first = self._sample_first(
-                logits,
-                jnp.asarray([gen.temperature], jnp.float32),
-                jnp.asarray([gen.top_k], jnp.int32),
-                jnp.asarray([gen.top_p], jnp.float32),
-                first_key[None])
-            token = int(first[0])
+            if pf.done >= pf.bucket:
+                gen = pf.request.gen
+                chain, first_key = jax.random.split(
+                    jax.random.PRNGKey(pf.request.seed))
+                first = self._sample_first(
+                    logits,
+                    jnp.asarray([gen.temperature], jnp.float32),
+                    jnp.asarray([gen.top_k], jnp.int32),
+                    jnp.asarray([gen.top_p], jnp.float32),
+                    first_key[None])
+                token = int(first[0])
+
+        rt_b = (self._rt.get(pf.request.request_id)
+                if self._reqtrace is not None else None)
+        if rt_b is not None:
+            # the span's own clock readings — chunk timing without a
+            # second timer around the device call
+            rt_b.prefill_chunk(sp["ts"], sp["dur"], offset0, cost,
+                               tick=self.steps)
+        if pf.done < pf.bucket:
+            return False
 
         t_first = time.time()
         trace.recorder().emit("serve_ttft", ts=pf.request.arrival,
                               dur=t_first - pf.request.arrival,
                               request=pf.request.request_id)
+        if rt_b is not None:
+            rt_b.first_token(t_first)
         running = _Running(request=pf.request, handle=pf.handle, token=token,
                            pos=next_pos, write_pos=pf.bucket,
                            key=np.asarray(chain), emitted=1,
@@ -699,6 +774,13 @@ class ServeEngine:
         new_keys = np.asarray(out["keys"])
         self._last_decode_dur = time.perf_counter() - t0
         self._note_decode_tick(t_wall, self._last_decode_dur, n_active)
+        if self._reqtrace is not None:
+            # tick-rate but bounded by max_slots dict lookups; tracing OFF
+            # skips even the branch body (the structural free-ness pin)
+            for r in self._occupants.values():
+                b = self._rt.get(r.request.request_id)
+                if b is not None:
+                    b.decode_tick(self.steps, n_active)
 
         for slot in list(self._occupants):
             r = self._occupants[slot]
@@ -738,6 +820,21 @@ class ServeEngine:
         self._tick_ts, self._tick_accum = 0.0, 0.0
         self._tick_count, self._tick_active = 0, 0
 
+    def _on_page_alloc(self, slot: int, pages: int) -> None:
+        """pages.PagedKVCache alloc_listener (installed only when tracing
+        is ON): attribute a page hand-out to the slot's owning request —
+        an occupant, or the mid-prefill request still filling the row."""
+        r = self._occupants.get(slot)
+        request_id = r.request.request_id if r is not None else None
+        if request_id is None:
+            for pf in self._prefilling:
+                if pf.slot == slot:
+                    request_id = pf.request.request_id
+                    break
+        b = self._rt.get(request_id) if request_id is not None else None
+        if b is not None:
+            b.page_alloc(self.steps, pages)
+
     def _finish(self, slot: int, r: _Running,
                 error: Exception | None = None) -> None:
         t_done = time.time()
@@ -751,16 +848,35 @@ class ServeEngine:
             tokens=r.emitted, ttft=ttft, tpot=tpot, queue_wait=queue_wait,
             slot=slot)
         self.stats.record(ttft=ttft, tpot=tpot, queue_wait=queue_wait,
-                          tokens=r.emitted)
+                          tokens=r.emitted, tenant=r.request.tenant)
+        breaches: list = []
+        capture_dir = None
         if self._slo is not None and error is None:
             breaches = self._slo.breaches(ttft, tpot, queue_wait)
             if breaches:
-                self.stats.record_slo_breach()
+                self.stats.record_slo_breach(r.request.tenant)
                 if self._profiler is not None:
                     # bounded capture of the ticks around the breach —
-                    # retention-capped, never raises into the loop
-                    self._profiler.trigger(
-                        f"serve_slo_{breaches[0]}", step=self.steps)
+                    # retention-capped, never raises into the loop. The
+                    # capture_meta carries the breaching request's trace
+                    # id, so the capture and the request-trace waterfall
+                    # name the same request.
+                    meta = {"request_id": r.request.request_id}
+                    if r.request.trace is not None:
+                        meta["trace_id"] = r.request.trace.trace_id
+                    if r.request.tenant:
+                        meta["tenant"] = r.request.tenant
+                    if self._profiler.trigger(f"serve_slo_{breaches[0]}",
+                                              step=self.steps, meta=meta):
+                        capture_dir = self._profiler.last_capture_dir
+        if self._reqtrace is not None:
+            b = self._rt.pop(r.request.request_id, None)
+            if b is not None:
+                self._reqtrace.write(b.build(
+                    "failed" if error is not None else "completed", t_done,
+                    tokens=r.emitted, ttft=ttft, tpot=tpot,
+                    queue_wait=queue_wait, slo_breach=breaches or None,
+                    capture=capture_dir))
         self._occupants.pop(slot, None)
         self.slots.release(slot)
         r.handle._finish(error)
@@ -824,18 +940,30 @@ class ServeEngine:
             self._closed = True
             pending = list(self._queue)
             self._queue.clear()
-        for _, handle, demand in pending:
+        for request, handle, demand in pending:
             if demand:
                 self.slots.unreserve(demand)
+            self._record_shed(request, "shutdown")
             handle._finish(err)
         while self._prefilling:
             pf = self._prefilling.popleft()
             self.slots.release(pf.slot)
+            self._write_failed_trace(pf.request, len(pf.handle.tokens_out))
             pf.handle._finish(err)
         for slot in list(self._occupants):
             r = self._occupants.pop(slot)
             self.slots.release(slot)
+            self._write_failed_trace(r.request, r.emitted)
             r.handle._finish(err)
+
+    def _write_failed_trace(self, request: ServeRequest, tokens: int) -> None:
+        """Shutdown path: an in-flight request's trace ends as `failed`."""
+        if self._reqtrace is None:
+            return
+        b = self._rt.pop(request.request_id, None)
+        if b is not None:
+            self._reqtrace.write(b.build("failed", time.time(),
+                                         tokens=tokens))
 
 
 class ServeLoop:

@@ -7,14 +7,25 @@ maps it onto sockets:
 
   POST /v1/generate   {"input_ids": [...], "max_new_tokens": 16,
                        "temperature": 0.8, "top_k": 40, "top_p": 0.95,
-                       "eos_token_id": 2, "seed": 7, "stream": true}
-    stream=false -> one JSON body {"request_id", "tokens"}.
+                       "eos_token_id": 2, "seed": 7, "stream": true,
+                       "tenant": "paid"}
+    stream=false -> one JSON body {"request_id", "trace_id", "tokens"}.
     stream=true  -> one JSON line per token {"token": id} as it is
-                    generated, then a final {"done": true, "request_id",
-                    "tokens"} line (connection close delimits the stream —
-                    HTTP/1.0 framing, curl/urllib read it naturally).
+                    generated — the FIRST line also carries "request_id"
+                    and "trace_id" — then a final {"done": true,
+                    "request_id", "trace_id", "tokens"} line (connection
+                    close delimits the stream — HTTP/1.0 framing,
+                    curl/urllib read it naturally).
   GET /healthz        engine SLO/occupancy snapshot (the same dict the
                       serving metrics line carries).
+
+Tracing contract (docs/SERVING.md "Request tracing"): an incoming W3C
+`traceparent` header joins the request to the caller's trace (malformed
+headers mint a fresh trace, never a 400); every response that decoded a
+request — 200, 429, 400, 503 — carries `X-Request-Id`, `X-Trace-Id`, and
+a `traceparent` response header. A client disconnect mid-stream bumps
+`requests_abandoned` and stamps the request trace; the request still
+decodes to completion — no cancellation protocol yet.
 
 Backpressure maps to status codes: ServeOverloaded -> 429 with a
 Retry-After header (wait queue full, or — its ServePagesExhausted
@@ -37,6 +48,7 @@ from llama_pipeline_parallel_tpu.serve.engine import (
     ServeOverloaded,
     ServeRequest,
 )
+from llama_pipeline_parallel_tpu.serve.reqtrace import TraceContext
 from llama_pipeline_parallel_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -45,17 +57,25 @@ GEN_KEYS = ("max_new_tokens", "temperature", "top_k", "top_p",
             "eos_token_id", "pad_token_id")
 
 
-def request_from_json(body: dict) -> ServeRequest:
-    """Decode one API request body; ValueError on malformed input."""
+def request_from_json(body: dict,
+                      traceparent: str | None = None) -> ServeRequest:
+    """Decode one API request body; ValueError on malformed input.
+    `traceparent` (the W3C header, when the caller sent one) joins this
+    request to the caller's distributed trace; a malformed header mints a
+    fresh trace instead of rejecting — tracing must never shed work."""
     if not isinstance(body, dict):
         raise ValueError("request body must be a JSON object")
     ids = body.get("input_ids")
     if (not isinstance(ids, list) or not ids
             or not all(isinstance(i, int) for i in ids)):
         raise ValueError("input_ids must be a non-empty list of ints")
+    tenant = body.get("tenant")
+    if tenant is not None and not isinstance(tenant, str):
+        raise ValueError("tenant must be a string when present")
     gen_kw = {k: body[k] for k in GEN_KEYS if body.get(k) is not None}
     return ServeRequest(input_ids=ids, gen=GenerationConfig(**gen_kw),
-                        seed=int(body.get("seed", 0)))
+                        seed=int(body.get("seed", 0)), tenant=tenant or None,
+                        trace=TraceContext.from_traceparent(traceparent))
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -87,15 +107,31 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json(200, self.engine.metrics_snapshot())
         return self._send_json(404, {"error": f"no route {self.path}"})
 
+    @staticmethod
+    def _trace_headers(request: ServeRequest,
+                       extra: dict | None = None) -> dict:
+        """Correlation headers on EVERY response for a decoded request —
+        success, 429, 400, and 503 alike: a shed client must still be able
+        to name the trace it was shed under."""
+        headers = {"X-Request-Id": request.request_id}
+        if request.trace is not None:
+            headers["X-Trace-Id"] = request.trace.trace_id
+            headers["traceparent"] = request.trace.traceparent()
+        if extra:
+            headers.update(extra)
+        return headers
+
     def do_POST(self):
         if self.path != "/v1/generate":
             return self._send_json(404, {"error": f"no route {self.path}"})
         try:
             length = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(length) or b"{}")
-            request = request_from_json(body)
+            request = request_from_json(body,
+                                        self.headers.get("traceparent"))
         except (ValueError, TypeError) as e:
             return self._send_json(400, {"error": str(e)})
+        trace_id = request.trace.trace_id if request.trace else None
         try:
             handle = self.engine.submit(request)
         except ServeOverloaded as e:
@@ -103,41 +139,73 @@ class _Handler(BaseHTTPRequestHandler):
             # (ServePagesExhausted) both tell the client to back off and
             # come back — the hint is coarse, not a promise
             retry = max(1, int(-(-getattr(e, "retry_after_s", 1.0) // 1)))
-            return self._send_json(429, {"error": str(e)},
-                                   headers={"Retry-After": str(retry)})
+            return self._send_json(
+                429, {"error": str(e), "request_id": request.request_id,
+                      "trace_id": trace_id},
+                headers=self._trace_headers(request,
+                                            {"Retry-After": str(retry)}))
         except RequestRejected as e:
-            return self._send_json(400, {"error": str(e)})
+            return self._send_json(
+                400, {"error": str(e), "request_id": request.request_id,
+                      "trace_id": trace_id},
+                headers=self._trace_headers(request))
         except EngineShutdown as e:  # process exiting: go to another replica
-            return self._send_json(503, {"error": str(e)})
+            return self._send_json(
+                503, {"error": str(e), "request_id": request.request_id,
+                      "trace_id": trace_id},
+                headers=self._trace_headers(request))
 
         if not body.get("stream"):
             try:
                 tokens = handle.result()
             except Exception as e:
-                return self._send_json(500, {"error": repr(e)})
-            return self._send_json(200, {"request_id": request.request_id,
-                                         "tokens": tokens})
+                return self._send_json(
+                    500, {"error": repr(e),
+                          "request_id": request.request_id,
+                          "trace_id": trace_id},
+                    headers=self._trace_headers(request))
+            return self._send_json(
+                200, {"request_id": request.request_id,
+                      "trace_id": trace_id, "tokens": tokens},
+                headers=self._trace_headers(request))
 
         self.send_response(200)
         self.send_header("Content-Type", "application/jsonlines")
+        for name, value in self._trace_headers(request).items():
+            self.send_header(name, value)
         self.end_headers()
         try:
+            first = True
             for token in handle.tokens():
-                self.wfile.write((json.dumps({"token": token}) + "\n").encode())
+                # the FIRST line carries the correlation ids, so a client
+                # can join a waterfall without waiting for the tail line
+                line = ({"token": token, "request_id": request.request_id,
+                         "trace_id": trace_id} if first
+                        else {"token": token})
+                first = False
+                self.wfile.write((json.dumps(line) + "\n").encode())
                 self.wfile.flush()
             tail = {"done": True, "request_id": request.request_id,
-                    "tokens": handle.tokens_out}
+                    "trace_id": trace_id, "tokens": handle.tokens_out}
+        except OSError:
+            # client hung up mid-stream; the request itself keeps running
+            # to completion (no cancellation protocol yet, docs/SERVING.md
+            # records the gap) — count the abandonment, stop writing
+            logger.debug("client disconnected during stream of %s",
+                         request.request_id)
+            self.engine.note_abandoned(request)
+            return
         except Exception as e:
             tail = {"done": True, "request_id": request.request_id,
-                    "error": repr(e)}
+                    "trace_id": trace_id, "error": repr(e)}
         try:
             self.wfile.write((json.dumps(tail) + "\n").encode())
         except OSError:
-            # client hung up mid-stream; the request itself keeps running
-            # to completion (no cancellation protocol yet) — just stop
-            # writing, don't let socketserver traceback every disconnect
-            logger.debug("client disconnected during stream of %s",
+            # disconnect raced the final write: same abandonment, observed
+            # one line later — don't let socketserver traceback on it
+            logger.debug("client disconnected during stream tail of %s",
                          request.request_id)
+            self.engine.note_abandoned(request)
 
 
 def make_server(engine: ServeEngine, host: str = "127.0.0.1",

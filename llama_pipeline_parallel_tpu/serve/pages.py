@@ -110,6 +110,12 @@ class PagedKVCache:
         self.assignments: list[tuple[int, str]] = []
         self.allocations = 1          # the pool is allocated ONCE
         self.page_allocations = 0     # cumulative page hand-outs (reuse proof)
+        # request-observatory hook (serve/reqtrace.py): called as
+        # `alloc_listener(slot, pages)` AFTER the lock is released whenever
+        # ensure_capacity hands out physical pages, so the engine can
+        # attribute every allocation to the slot's owning request. None
+        # (the default) costs one predicted-false branch per call.
+        self.alloc_listener = None
 
     # -- gauges ------------------------------------------------------------
 
@@ -228,7 +234,9 @@ class PagedKVCache:
                 owned.append(page)
                 self.page_allocations += 1
                 grew += 1
-            return grew
+        if grew and self.alloc_listener is not None:
+            self.alloc_listener(slot, grew)
+        return grew
 
     def release(self, slot: int) -> None:
         with self._lock:

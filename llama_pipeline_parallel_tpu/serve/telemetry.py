@@ -34,7 +34,12 @@ import zlib
 # tools/goodput_report.py so the two reports cannot drift
 SERVE_COUNTER_KEYS = ("requests_completed", "requests_rejected",
                       "requests_failed", "requests_page_refused",
-                      "slo_breaches", "tokens_generated")
+                      "requests_abandoned", "slo_breaches",
+                      "tokens_generated")
+
+# per-tenant percentile window: smaller than the global one — a tenant is
+# a slice of the traffic, and the point is CURRENT per-class tail latency
+TENANT_WINDOW = 256
 
 
 @dataclasses.dataclass(frozen=True)
@@ -107,6 +112,40 @@ def percentiles_ms(values, prefix: str, qs=(50, 95, 99)) -> dict:
     return out
 
 
+class _TenantStats:
+    """One tenant's slice of the accounting: cumulative counters plus a
+    bounded percentile window. Mutated only under the owning SLOStats
+    lock — no lock of its own."""
+
+    __slots__ = ("completed", "rejected", "failed", "abandoned",
+                 "slo_breaches", "tokens_generated", "ttft", "tpot",
+                 "queue_wait")
+
+    def __init__(self, window: int = TENANT_WINDOW):
+        self.completed = 0
+        self.rejected = 0
+        self.failed = 0
+        self.abandoned = 0
+        self.slo_breaches = 0
+        self.tokens_generated = 0
+        self.ttft = collections.deque(maxlen=window)
+        self.tpot = collections.deque(maxlen=window)
+        self.queue_wait = collections.deque(maxlen=window)
+
+    def snapshot(self) -> dict:
+        out = {"requests_completed": self.completed,
+               "requests_rejected": self.rejected,
+               "requests_failed": self.failed,
+               "requests_abandoned": self.abandoned,
+               "slo_breaches": self.slo_breaches,
+               "tokens_generated": self.tokens_generated}
+        out.update(percentiles_ms(list(self.ttft), "ttft", qs=(50, 95)))
+        out.update(percentiles_ms(list(self.tpot), "tpot", qs=(50, 95)))
+        out.update(percentiles_ms(list(self.queue_wait), "queue_wait",
+                                  qs=(50, 95)))
+        return out
+
+
 class SLOStats:
     """Rolling serving-SLO accumulator (thread-safe: the engine loop records
     while frontend threads snapshot for /healthz).
@@ -114,6 +153,12 @@ class SLOStats:
     Percentiles are over a bounded window of the most recent `window`
     requests — a long-lived serve process must report CURRENT tail latency,
     not its lifetime average — while the counters are cumulative.
+
+    Every record method takes an optional `tenant`: a named tenant gets
+    its own `_TenantStats` slice (per-class counters + percentiles under
+    the same SERVE_COUNTER_KEYS spellings), surfaced as the `tenants` map
+    in `snapshot()` — the scaffolding ROADMAP item 2's per-tenant quotas
+    will actuate on. `tenant=None` (the default) changes nothing.
     """
 
     def __init__(self, window: int = 1024):
@@ -128,11 +173,22 @@ class SLOStats:
         self.rejected = 0
         self.failed = 0
         self.page_refused = 0
+        self.abandoned = 0
         self.slo_breaches = 0
         self.tokens_generated = 0
+        self._tenants: dict[str, _TenantStats] = {}
+
+    def _tenant(self, tenant: str | None) -> "_TenantStats | None":
+        # caller holds the lock
+        if not tenant:
+            return None
+        ts = self._tenants.get(tenant)
+        if ts is None:
+            ts = self._tenants[tenant] = _TenantStats()
+        return ts
 
     def record(self, ttft: float, tpot: float | None, queue_wait: float,
-               tokens: int) -> None:
+               tokens: int, tenant: str | None = None) -> None:
         with self._lock:
             self.completed += 1
             self.tokens_generated += tokens
@@ -141,10 +197,21 @@ class SLOStats:
             self.finished_at.append(time.monotonic())
             if tpot is not None:
                 self.tpot.append(tpot)
+            ts = self._tenant(tenant)
+            if ts is not None:
+                ts.completed += 1
+                ts.tokens_generated += tokens
+                ts.ttft.append(ttft)
+                ts.queue_wait.append(queue_wait)
+                if tpot is not None:
+                    ts.tpot.append(tpot)
 
-    def record_rejected(self) -> None:
+    def record_rejected(self, tenant: str | None = None) -> None:
         with self._lock:
             self.rejected += 1
+            ts = self._tenant(tenant)
+            if ts is not None:
+                ts.rejected += 1
 
     def drain_rate(self, window_s: float = DRAIN_WINDOW_S,
                    now: float | None = None) -> float | None:
@@ -157,19 +224,36 @@ class SLOStats:
             recent = sum(1 for t in self.finished_at if now - t <= window_s)
         return recent / window_s if recent else None
 
-    def record_failed(self) -> None:
+    def record_failed(self, tenant: str | None = None) -> None:
         """Accepted but errored (admission/engine failure, not a client
         mistake): these must move a counter too, or an error storm looks
         like a healthy idle replica."""
         with self._lock:
             self.failed += 1
+            ts = self._tenant(tenant)
+            if ts is not None:
+                ts.failed += 1
 
-    def record_slo_breach(self) -> None:
+    def record_abandoned(self, tenant: str | None = None) -> None:
+        """The client hung up mid-stream (frontend OSError path). The
+        request still decodes to completion — there is no cancellation
+        protocol yet — so abandoned work is INVISIBLE compute unless
+        counted: this is the honest gauge of tokens generated for nobody."""
+        with self._lock:
+            self.abandoned += 1
+            ts = self._tenant(tenant)
+            if ts is not None:
+                ts.abandoned += 1
+
+    def record_slo_breach(self, tenant: str | None = None) -> None:
         """A completed request blew a configured SLOThresholds limit —
         counted next to the percentiles so an operator sees breach RATE,
         not just the rolling tail."""
         with self._lock:
             self.slo_breaches += 1
+            ts = self._tenant(tenant)
+            if ts is not None:
+                ts.slo_breaches += 1
 
     def record_page_refused(self) -> None:
         """Rejected because the free-page pool could not cover the
@@ -187,10 +271,14 @@ class SLOStats:
                 "requests_rejected": self.rejected,
                 "requests_failed": self.failed,
                 "requests_page_refused": self.page_refused,
+                "requests_abandoned": self.abandoned,
                 "slo_breaches": self.slo_breaches,
                 "tokens_generated": self.tokens_generated,
             }
             out.update(percentiles_ms(list(self.ttft), "ttft"))
             out.update(percentiles_ms(list(self.tpot), "tpot"))
             out.update(percentiles_ms(list(self.queue_wait), "queue_wait"))
+            if self._tenants:
+                out["tenants"] = {name: ts.snapshot() for name, ts in
+                                  sorted(self._tenants.items())}
             return out

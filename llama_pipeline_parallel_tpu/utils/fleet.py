@@ -236,8 +236,8 @@ def latest_verified_step(checkpoint_root: str) -> int | None:
 # ---------------------------------------------------------------------------
 
 ALERT_KEYS = {"heartbeat_stale_s", "goodput_floor", "step_time_p95_s",
-              "ttft_p95_ms", "queue_wait_p95_ms", "checkpoint_lag_steps",
-              "nonfinite_steps", "oom_recent"}
+              "ttft_p95_ms", "queue_wait_p95_ms", "tenant_ttft_p95_ms",
+              "checkpoint_lag_steps", "nonfinite_steps", "oom_recent"}
 # config key -> the rule name edges/status use (the `_s`/`_ms` unit
 # suffixes are config spelling, not alert identity)
 _RULE_NAMES = {"heartbeat_stale_s": "heartbeat_stale",
@@ -245,6 +245,7 @@ _RULE_NAMES = {"heartbeat_stale_s": "heartbeat_stale",
                "step_time_p95_s": "step_time_p95",
                "ttft_p95_ms": "ttft_p95",
                "queue_wait_p95_ms": "queue_wait_p95",
+               "tenant_ttft_p95_ms": "tenant_ttft_p95",
                "checkpoint_lag_steps": "checkpoint_lag",
                "nonfinite_steps": "nonfinite_steps",
                "oom_recent": "oom_recent"}
@@ -276,6 +277,12 @@ class AlertRules:
     - ttft_p95_ms: a serve replica's rolling TTFT p95 above this.
     - queue_wait_p95_ms: a serve replica's rolling queue-wait p95 above
       this (admission latency — the autoscaler's primary borrow signal).
+    - tenant_ttft_p95_ms: ONE threshold evaluated per tenant in a serve
+      replica's `tenants` map (serve/telemetry.py per-tenant slices);
+      each tenant gets its own rule instance named
+      `tenant_ttft_p95:<tenant>` — independent fire/resolve edges and
+      damping state per tenant, the scaffolding per-tenant SLO classes
+      (ROADMAP item 2) will actuate on.
     - checkpoint_lag_steps: serve replica's loaded checkpoint step more
       than this many steps behind the trainer's latest verified one.
     - nonfinite_steps: more than this many nonfinite training steps
@@ -293,6 +300,7 @@ class AlertRules:
     step_time_p95_s: float | None = None
     ttft_p95_ms: float | None = None
     queue_wait_p95_ms: float | None = None
+    tenant_ttft_p95_ms: float | None = None
     checkpoint_lag_steps: int | None = None
     nonfinite_steps: int | None = None
     oom_recent: int | None = None
@@ -341,8 +349,12 @@ class AlertRules:
         return cls(**kw)
 
     def damping_for(self, rule: str) -> tuple:
-        """(for_s, cooldown_s) for one rule name; (0, 0) when undamped."""
-        return (self.damping or {}).get(rule, (0.0, 0.0))
+        """(for_s, cooldown_s) for one rule name; (0, 0) when undamped.
+        Per-tenant rule instances (`tenant_ttft_p95:<tenant>`) inherit
+        the base rule's damping — the `:` suffix is instance identity,
+        not a second config surface."""
+        base = rule.split(":", 1)[0]
+        return (self.damping or {}).get(base, (0.0, 0.0))
 
     def evaluate(self, member: dict) -> list[tuple[str, float, float, bool]]:
         """(rule, value, threshold, firing) for every rule whose input
@@ -381,6 +393,18 @@ class AlertRules:
         rule("queue_wait_p95", qw, self.queue_wait_p95_ms,
              qw is not None and self.queue_wait_p95_ms is not None
              and qw > self.queue_wait_p95_ms)
+        # ONE configured threshold, one rule INSTANCE per tenant: each
+        # tenant's edge/damping state is independent (a paid-tier breach
+        # must not be masked by a healthy free tier resolving)
+        tenants = member.get("tenants")
+        if isinstance(tenants, dict) and self.tenant_ttft_p95_ms is not None:
+            for name in sorted(tenants):
+                snap = tenants[name]
+                if not isinstance(snap, dict):
+                    continue
+                tt = _num(snap.get("ttft_p95_ms"))
+                rule(f"tenant_ttft_p95:{name}", tt, self.tenant_ttft_p95_ms,
+                     tt is not None and tt > self.tenant_ttft_p95_ms)
         lag = _num(member.get("checkpoint_lag"))
         rule("checkpoint_lag", lag, self.checkpoint_lag_steps,
              lag is not None and self.checkpoint_lag_steps is not None
@@ -414,7 +438,7 @@ _SERVE_FIELDS = ("requests_completed", "requests_rejected", "requests_failed",
                  "pages_reserved", "pages_total", "reserved_unbacked",
                  "page_fragmentation", "reserved_gap_bytes",
                  "page_allocations", "prefilling", "prefill_chunks_total",
-                 "prefill_tokens_total")
+                 "prefill_tokens_total", "requests_abandoned", "tenants")
 _STEP_TIME_WINDOW = 64
 
 

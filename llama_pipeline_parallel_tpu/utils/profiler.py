@@ -120,6 +120,10 @@ class TriggeredProfiler:
         self._remaining = 0
         self._pending_at = set(cfg.at_step)
         self.captures_taken = 0
+        # the most recent capture dir that actually started (active or
+        # finished) — the serving engine links a breaching request's trace
+        # record to the capture written for it
+        self.last_capture_dir: str | None = None
         # fleet cross-process trigger (utils/fleet.py drops the file); the
         # first poll is due immediately — a trigger left while this process
         # was dead must fire on the first post-relaunch step
@@ -197,11 +201,14 @@ class TriggeredProfiler:
         if self.cfg.on_anomaly and rec.get("name") == "numerics_anomaly":
             self.trigger("numerics_anomaly", step=rec.get("step"))
 
-    def trigger(self, reason: str, step: int | None = None) -> bool:
+    def trigger(self, reason: str, step: int | None = None,
+                meta: dict | None = None) -> bool:
         """Start a bounded capture now (any trigger surface, including
         serving SLO breaches). Returns True when a capture actually
         started — False while one is active or the retention cap is
-        reached."""
+        reached. `meta` (e.g. the breaching request's trace id) is written
+        as `capture_meta.json` inside the capture dir, so the capture and
+        the request-trace waterfall name the same request."""
         if self._active_dir is not None:
             return False
         if self.captures_taken >= self.cfg.max_captures:
@@ -211,11 +218,12 @@ class TriggeredProfiler:
         tag = f"step{step}-{_safe_reason(reason)}" if step is not None \
             else _safe_reason(reason)
         path = os.path.join(self.dir, f"{int(time.time())}-{tag}")
-        return self._start(path, reason)
+        return self._start(path, reason, step=step, meta=meta)
 
     # -- capture mechanics --------------------------------------------------
 
-    def _start(self, path: str, reason: str) -> bool:
+    def _start(self, path: str, reason: str, step: int | None = None,
+               meta: dict | None = None) -> bool:
         try:
             import jax
 
@@ -228,8 +236,19 @@ class TriggeredProfiler:
                            reason, e)
             return False
         self._active_dir = path
+        self.last_capture_dir = path
         self._remaining = self.cfg.window_steps
         self.captures_taken += 1
+        try:
+            record = {"reason": reason, "time": time.time()}
+            if step is not None:
+                record["step"] = step
+            if meta:
+                record.update(meta)
+            with open(os.path.join(path, "capture_meta.json"), "w") as f:
+                json.dump(record, f, indent=2)
+        except OSError:  # the trace is the payload; meta is best-effort
+            logger.exception("capture_meta.json write failed (%s)", path)
         logger.warning("profiler capture started (%s): %s — %d step(s)",
                        reason, path, self.cfg.window_steps)
         return True

@@ -269,6 +269,59 @@ class RunClock:
                 "buckets": out}
 
 
+# -- W3C trace context -------------------------------------------------------
+#
+# The serving tier's per-request identity (serve/reqtrace.py): a request
+# either arrives with a `traceparent` header (the caller's distributed
+# trace adopts our span tree) or is minted one at submit. Plain python on
+# purpose — the frontend parses headers and offline reports join on trace
+# ids without jax. Format (https://www.w3.org/TR/trace-context/):
+#   00-<32 hex trace-id>-<16 hex parent-span-id>-<2 hex flags>
+
+def mint_trace_id() -> str:
+    """32 lowercase hex chars, never all-zero (the spec's invalid value)."""
+    while True:
+        tid = os.urandom(16).hex()
+        if tid != "0" * 32:
+            return tid
+
+
+def mint_span_id() -> str:
+    """16 lowercase hex chars, never all-zero."""
+    while True:
+        sid = os.urandom(8).hex()
+        if sid != "0" * 16:
+            return sid
+
+
+def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+    """(trace_id, parent_span_id) from a `traceparent` header, or None on
+    anything malformed — a bad header degrades to a freshly minted trace,
+    never a 400 (tracing must not be able to reject work)."""
+    if not isinstance(header, str):
+        return None
+    parts = header.strip().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id = parts[0], parts[1], parts[2]
+    if version == "ff" or len(version) != 2:
+        return None
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    # the spec mandates LOWERCASE hex; uppercase is malformed, not lenient
+    if any(c not in "0123456789abcdef"
+           for c in version + trace_id + span_id):
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+def format_traceparent(trace_id: str, span_id: str,
+                       sampled: bool = True) -> str:
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"
+
+
 # -- device memory telemetry -------------------------------------------------
 
 def device_peak_bytes() -> tuple[int | None, str]:

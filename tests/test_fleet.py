@@ -197,6 +197,27 @@ def test_alert_rules_evaluate_role_and_absence():
         [("heartbeat_stale", 1.0, 10.0, False)]
 
 
+def test_alert_rules_per_tenant_ttft_fanout():
+    """One configured `tenant_ttft_p95_ms` threshold fans out to a rule
+    INSTANCE per tenant in the member's serving snapshot
+    (`tenant_ttft_p95:<tenant>`), all sharing the base rule's threshold
+    and damping — the ':' suffix is instance identity, not config."""
+    rules = AlertRules(tenant_ttft_p95_ms=100.0,
+                       damping={"tenant_ttft_p95": (5.0, 10.0)})
+    out = rules.evaluate({"role": "serve", "tenants": {
+        "free": {"ttft_p95_ms": 250.0},
+        "paid": {"ttft_p95_ms": 40.0},
+        "torn": "not a snapshot",          # tolerated, not evaluated
+        "silent": {"requests_completed": 3}}})   # no ttft yet: absent
+    assert dict((r[0], r[3]) for r in out) == \
+        {"tenant_ttft_p95:free": True, "tenant_ttft_p95:paid": False}
+    assert all(r[2] == 100.0 for r in out)
+    assert rules.damping_for("tenant_ttft_p95:free") == (5.0, 10.0)
+    # no threshold configured -> the tenants map is never judged
+    assert AlertRules().evaluate(
+        {"role": "serve", "tenants": {"free": {"ttft_p95_ms": 9e9}}}) == []
+
+
 # ---------------------------------------------------------------------------
 # the aggregator
 # ---------------------------------------------------------------------------

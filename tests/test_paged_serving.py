@@ -212,6 +212,9 @@ def test_paged_token_parity_vs_dense_and_generate(setup):
         assert streams["paged"][i] == reference_tokens(params, cfg, p, g, i)
 
 
+@pytest.mark.slow  # funds the Request trace tier-1 rows: this is the fp32
+# parity grid above re-run in bf16 — a dtype variant of an identical
+# contract, not a new one; it stays pinned in the slow/round gate.
 def test_paged_token_parity_bit_exact_bf16(setup):
     """The same bit-parity contract in the serving compute dtype: bf16
     paged streams equal the bf16 dense streams and the bf16 generate()
@@ -417,6 +420,12 @@ def test_page_exhaustion_maps_to_http_429_with_retry_after(setup):
         with pytest.raises(urllib.error.HTTPError) as err:
             post({"input_ids": [5, 6], "seed": 9, **gen})
         assert err.value.code == 429
+        # a shed client can still name its trace (docs/SERVING.md
+        # "Request tracing"): correlation ids ride the 429 too
+        assert err.value.headers["X-Request-Id"]
+        assert err.value.headers["X-Trace-Id"]
+        body_429 = json.loads(err.value.read())
+        assert body_429["trace_id"] == err.value.headers["X-Trace-Id"]
         assert int(err.value.headers["Retry-After"]) >= 1
         with ServeLoop(engine, idle_wait_s=0.005):
             for h in fillers:

@@ -62,7 +62,7 @@ def test_at_step_deferred_semantics(tmp_path, monkeypatch):
         str(tmp_path))
     started = []
 
-    def fake_start(path, reason):
+    def fake_start(path, reason, step=None, meta=None):
         prof._active_dir = path
         prof._remaining = prof.cfg.window_steps
         prof.captures_taken += 1

@@ -74,3 +74,40 @@ def test_run_trace_against_chunked_paged_engine():
     assert "ttft_p50_ms" in summary
     assert summary["prefill_chunks_total"] >= summary["submitted"]
     assert summary["pages_total"] == 24
+
+
+def test_parse_tenant_mix_normalizes_and_validates():
+    mix = serve_traffic.parse_tenant_mix("free:4,paid:1")
+    assert mix == (("free", 0.8), ("paid", 0.2))
+    assert serve_traffic.parse_tenant_mix("paid") == (("paid", 1.0),)
+    assert serve_traffic.tenant_mix_label(mix) == "free:0.8,paid:0.2"
+    with pytest.raises(ValueError):
+        serve_traffic.parse_tenant_mix("")
+    with pytest.raises(ValueError):
+        serve_traffic.parse_tenant_mix(":1")          # empty tenant name
+    with pytest.raises(ValueError):
+        serve_traffic.parse_tenant_mix("free:0,paid:0")  # zero total weight
+
+
+def test_poisson_trace_tenants_deterministic_and_legacy_identical():
+    prompt_mix = serve_traffic.parse_mix("8:0.5,16:0.5")
+    output_mix = serve_traffic.parse_mix("4:1")
+    tenant_mix = serve_traffic.parse_tenant_mix("free:0.8,paid:0.2")
+    a = serve_traffic.poisson_trace(7, 10.0, 200, prompt_mix, output_mix,
+                                    tenant_mix=tenant_mix)
+    b = serve_traffic.poisson_trace(7, 10.0, 200, prompt_mix, output_mix,
+                                    tenant_mix=tenant_mix)
+    assert a == b                                   # seeded: bit-identical
+    tenants = [t.tenant for t in a]
+    assert set(tenants) == {"free", "paid"}         # both arms drawn
+    assert 100 < tenants.count("free") < 200        # roughly the 0.8 weight
+
+    # the tenant draw happens AFTER the per-request length/seed draws, so
+    # a tenantless trace is bit-identical to one generated before tenants
+    # existed — stamping tenants changes ONLY the tenant field
+    legacy = serve_traffic.poisson_trace(7, 10.0, 200, prompt_mix,
+                                         output_mix)
+    assert all(t.tenant is None for t in legacy)
+    assert [(t.arrival_s, t.prompt_len, t.max_new_tokens, t.seed)
+            for t in legacy] == \
+        [(t.arrival_s, t.prompt_len, t.max_new_tokens, t.seed) for t in a]

@@ -344,28 +344,52 @@ def test_http_frontend_inprocess(setup):
     threading.Thread(target=server.serve_forever, daemon=True).start()
     try:
         with ServeLoop(engine, idle_wait_s=0.005):
-            def post(body):
+            def post(body, headers=None):
                 req = urllib.request.Request(
                     f"http://127.0.0.1:{port}/v1/generate",
                     data=json.dumps(body).encode(),
-                    headers={"Content-Type": "application/json"})
+                    headers={"Content-Type": "application/json",
+                             **(headers or {})})
                 return urllib.request.urlopen(req, timeout=60)
 
-            out = json.load(post({"input_ids": [5, 6], "max_new_tokens": 3,
-                                  "seed": 1}))
+            resp = post({"input_ids": [5, 6], "max_new_tokens": 3,
+                         "seed": 1})
+            # correlation contract (docs/SERVING.md "Request tracing"):
+            # ids in the body AND the response headers, joined by the
+            # incoming W3C traceparent when the caller sent one
+            assert resp.headers["X-Request-Id"]
+            assert resp.headers["X-Trace-Id"]
+            assert resp.headers["traceparent"].startswith("00-")
+            out = json.load(resp)
+            assert out["request_id"] == resp.headers["X-Request-Id"]
+            assert out["trace_id"] == resp.headers["X-Trace-Id"]
             assert out["tokens"] == reference_tokens(
                 params, cfg, [5, 6], GenerationConfig(max_new_tokens=3), 1)
+
+            parent = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+            joined = post({"input_ids": [5, 6], "max_new_tokens": 1},
+                          headers={"traceparent": parent})
+            assert joined.headers["X-Trace-Id"] == "ab" * 16  # adopted
+            assert joined.headers["traceparent"] != parent    # our span id
 
             stream = post({"input_ids": [4, 5], "max_new_tokens": 4,
                            "temperature": 0.8, "top_p": 0.9, "seed": 2,
                            "stream": True})
+            assert stream.headers["X-Trace-Id"]
             lines = [json.loads(l) for l in stream.read().decode().splitlines()]
             assert [l["token"] for l in lines[:-1]] == lines[-1]["tokens"]
             assert lines[-1]["done"] is True
+            # the FIRST streamed line carries the correlation ids (a client
+            # can join a waterfall without waiting for the tail line);
+            # later token lines stay minimal
+            assert lines[0]["request_id"] == stream.headers["X-Request-Id"]
+            assert lines[0]["trace_id"] == stream.headers["X-Trace-Id"]
+            assert all(set(l) == {"token"} for l in lines[1:-1])
+            assert lines[-1]["trace_id"] == stream.headers["X-Trace-Id"]
 
             health = json.load(urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/healthz", timeout=10))
-            assert health["serving"] == 1 and health["requests_completed"] == 2
+            assert health["serving"] == 1 and health["requests_completed"] == 3
 
             with pytest.raises(urllib.error.HTTPError) as err:
                 post({"input_ids": "nope"})

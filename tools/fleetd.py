@@ -19,7 +19,10 @@ Usage:
       --refresh-s 2 --alerts '{"heartbeat_stale_s": 30, "ttft_p95_ms": 500}'
 
 `--alerts` takes inline JSON or `@/path/to/alerts.json` (unknown keys
-rejected — the config-block house rule). `--once` performs a single
+rejected — the config-block house rule). A `tenant_ttft_p95_ms`
+threshold fans out per tenant: one `tenant_ttft_p95:<tenant>` rule
+instance per tenant found in a member's serving snapshot, all sharing
+the one configured threshold. `--once` performs a single
 refresh, prints the status JSON, and exits (cron / CI probes).
 SIGTERM/SIGINT exit cleanly after the current refresh. Alert edges are
 echoed to stdout as they happen, so a supervisor-of-supervisors log shows

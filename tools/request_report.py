@@ -1,0 +1,267 @@
+#!/usr/bin/env python
+"""Offline tail-latency forensics from a request-trace directory.
+
+Reads the artifacts a tracing-enabled serve run left behind —
+`request_trace.jsonl` (one span tree per finished request, written by
+serve/reqtrace.py) and `request_trace_exemplars.json` (the slowest-K
+snapshot) — and answers "which request paid the p99 and WHERE":
+
+- the p99-TTFT exemplar's waterfall (queue wait, admission verdict, each
+  prefill chunk, first token, decode-tick summary), rendered against the
+  request's own arrival time;
+- a tail-attribution table decomposing tail TTFT into its phases —
+  queue wait, the request's OWN prefill chunks, and the gap between them
+  (time spent waiting behind a chunking neighbor's prefill ticks);
+- per-tenant tables (counts, tokens, TTFT/TPOT percentiles) when the
+  trace carries tenants.
+
+    python tools/request_report.py /runs/serve1
+    python tools/request_report.py /runs/serve1 --json
+
+Degrades instead of tracebacking on missing/torn files (the
+goodput_report.py contract): a crashed replica's directory must still
+report whatever it managed to record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from llama_pipeline_parallel_tpu.serve.reqtrace import (  # noqa: E402
+    EXEMPLARS_NAME,
+    REQUEST_TRACE_NAME,
+)
+from llama_pipeline_parallel_tpu.serve.telemetry import (  # noqa: E402
+    percentiles_ms,
+)
+
+
+def load_trace(output_dir: str) -> list[dict]:
+    """Parseable dict rows only — `perf.read_jsonl`, the one spelling of
+    the tolerant reader (a torn tail or garbage line is skipped)."""
+    from llama_pipeline_parallel_tpu.utils.perf import read_jsonl
+
+    return read_jsonl(os.path.join(output_dir, REQUEST_TRACE_NAME))
+
+
+def load_exemplars(output_dir: str) -> dict:
+    try:
+        with open(os.path.join(output_dir, EXEMPLARS_NAME)) as f:
+            snap = json.load(f)
+        return snap if isinstance(snap, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def _num(v) -> float | None:
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def ttft_breakdown(rec: dict) -> dict | None:
+    """Decompose one record's TTFT into queue / own-prefill / interleave.
+
+    queue is the recorded queue-wait, prefill is the sum of the request's
+    own chunk durations (`prefill_s`), and interleave is whatever remains
+    of TTFT — under chunked batched prefill that remainder is the time
+    the request's chunks spent parked behind a neighbor's turn on the
+    shared tick, the "prefill-behind-chunked-neighbor" phase.
+    """
+    ttft = _num(rec.get("ttft_s"))
+    if ttft is None or ttft <= 0:
+        return None
+    queue = _num(rec.get("queue_wait_s")) or 0.0
+    prefill = _num(rec.get("prefill_s")) or 0.0
+    interleave = max(ttft - queue - prefill, 0.0)
+    decode = max((_num(rec.get("wall_s")) or ttft) - ttft, 0.0)
+    return {"ttft_s": ttft,
+            "queue_s": round(queue, 6),
+            "prefill_s": round(prefill, 6),
+            "interleave_s": round(interleave, 6),
+            "decode_s": round(decode, 6),
+            "queue_pct": round(100 * queue / ttft, 1),
+            "prefill_pct": round(100 * prefill / ttft, 1),
+            "interleave_pct": round(100 * interleave / ttft, 1)}
+
+
+def tail_attribution(records: list[dict], quantile: float = 99.0) -> dict:
+    """Aggregate breakdown over the TTFT tail (records at or above the
+    given TTFT percentile): where does tail TTFT actually go?"""
+    timed = [(r, _num(r.get("ttft_s"))) for r in records]
+    timed = [(r, t) for r, t in timed if t is not None and t > 0]
+    if not timed:
+        return {}
+    values = sorted(t for _, t in timed)
+    idx = min(int(len(values) * quantile / 100.0), len(values) - 1)
+    cut = values[idx]
+    tail = [r for r, t in timed if t >= cut]
+    queue = sum((_num(r.get("queue_wait_s")) or 0.0) for r in tail)
+    prefill = sum((_num(r.get("prefill_s")) or 0.0) for r in tail)
+    ttft = sum(t for _, t in timed if t >= cut)
+    interleave = max(ttft - queue - prefill, 0.0)
+    return {"quantile": quantile, "cut_ttft_s": round(cut, 6),
+            "requests": len(tail),
+            "queue_pct": round(100 * queue / ttft, 1),
+            "prefill_pct": round(100 * prefill / ttft, 1),
+            "interleave_pct": round(100 * interleave / ttft, 1)}
+
+
+def tenant_tables(records: list[dict]) -> dict:
+    """Per-tenant slices of the trace (completed outcomes drive the
+    latency percentiles; shed/abandoned are counted separately)."""
+    tenants: dict[str, dict] = {}
+    for rec in records:
+        tenant = rec.get("tenant")
+        if not isinstance(tenant, str):
+            continue
+        t = tenants.setdefault(tenant, {"completed": 0, "shed": 0,
+                                        "abandoned": 0, "failed": 0,
+                                        "tokens": 0, "_ttft": [], "_tpot": []})
+        outcome = rec.get("outcome")
+        if outcome in t:
+            t[outcome] += 1
+        if outcome == "completed":
+            t["tokens"] += int(rec.get("tokens") or 0)
+            for metric in ("ttft", "tpot"):
+                v = _num(rec.get(f"{metric}_s"))
+                if v is not None:
+                    t[f"_{metric}"].append(v)
+    out = {}
+    for name in sorted(tenants):
+        t = tenants[name]
+        row = {k: v for k, v in t.items() if not k.startswith("_")}
+        row.update(percentiles_ms(t["_ttft"], "ttft", qs=(50, 95, 99)))
+        row.update(percentiles_ms(t["_tpot"], "tpot", qs=(50, 95, 99)))
+        out[name] = row
+    return out
+
+
+def exemplar_waterfall(rec: dict) -> list[str]:
+    """Render one record's span tree as offset/duration lines relative to
+    the request's arrival — the human-readable waterfall."""
+    arrival = _num(rec.get("arrival"))
+    lines = [f"  request {rec.get('request_id')} trace {rec.get('trace_id')}"
+             f" tenant={rec.get('tenant')} outcome={rec.get('outcome')}"
+             f" tokens={rec.get('tokens')}"]
+    bd = ttft_breakdown(rec)
+    if bd:
+        lines.append(
+            f"  ttft {1000 * bd['ttft_s']:.1f} ms = "
+            f"{bd['queue_pct']}% queue + {bd['prefill_pct']}% own prefill "
+            f"+ {bd['interleave_pct']}% prefill-behind-chunked-neighbor; "
+            f"decode {1000 * bd['decode_s']:.1f} ms")
+    for span in rec.get("spans") or []:
+        if not isinstance(span, dict):
+            continue
+        name = span.get("name", "?")
+        ts = _num(span.get("ts"))
+        off = (f"+{1000 * (ts - arrival):8.1f} ms" if ts is not None
+               and arrival is not None else f"tick {span.get('tick', '?')}")
+        dur = _num(span.get("dur"))
+        dur_s = f" for {1000 * dur:7.1f} ms" if dur is not None else ""
+        extras = " ".join(f"{k}={span[k]}" for k in
+                          ("slot", "bucket", "verdict", "offset", "tokens",
+                           "pages") if k in span)
+        lines.append(f"    {off}{dur_s}  {name:<14} {extras}".rstrip())
+    decode = rec.get("decode")
+    if isinstance(decode, dict):
+        lines.append(f"    decode: ticks {decode.get('first_tick')}.."
+                     f"{decode.get('last_tick')} ({decode.get('ticks')} "
+                     f"total), shared_with={decode.get('shared_with')}")
+    if rec.get("slo_breach"):
+        lines.append(f"    SLO breach: {rec['slo_breach']}"
+                     + (f" -> capture {rec['capture']}"
+                        if rec.get("capture") else ""))
+    return lines
+
+
+def build_report(output_dir: str) -> dict:
+    records = load_trace(output_dir)
+    exemplars = load_exemplars(output_dir)
+    completed = [r for r in records if r.get("outcome") == "completed"]
+    shed = [r for r in records if r.get("outcome") == "shed"]
+    ttft = [v for r in completed
+            if (v := _num(r.get("ttft_s"))) is not None]
+    tpot = [v for r in completed
+            if (v := _num(r.get("tpot_s"))) is not None]
+    timed = [(r, t) for r in completed
+             if (t := _num(r.get("ttft_s"))) is not None]
+    p99_exemplar = max(timed, key=lambda it: it[1])[0] if timed else None
+    return {"output_dir": output_dir,
+            "records": len(records),
+            "completed": len(completed),
+            "shed": len(shed),
+            "abandoned": sum(1 for r in records
+                             if r.get("outcome") == "abandoned"
+                             or r.get("abandoned")),
+            "ttft": percentiles_ms(ttft, "ttft", qs=(50, 95, 99)),
+            "tpot": percentiles_ms(tpot, "tpot", qs=(50, 95, 99)),
+            "tail": tail_attribution(completed),
+            "tenants": tenant_tables(records),
+            "p99_exemplar": p99_exemplar,
+            "exemplars": {m: [r.get("request_id") for r in recs
+                              if isinstance(r, dict)]
+                          for m, recs in exemplars.items()
+                          if isinstance(recs, list)}}
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("output_dir")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full report as one JSON object")
+    args = p.parse_args(argv)
+    rep = build_report(args.output_dir)
+    if args.json:
+        print(json.dumps(rep, indent=2))
+        return 0 if rep["records"] else 1
+
+    print(f"== request trace report: {rep['output_dir']} ==")
+    if not rep["records"]:
+        print(f"  no {REQUEST_TRACE_NAME} records found — tracing was off, "
+              "or the directory is not a traced serve run")
+        return 1
+    print(f"  {rep['records']} records: {rep['completed']} completed, "
+          f"{rep['shed']} shed, {rep['abandoned']} abandoned")
+    for metric in ("ttft", "tpot"):
+        table = rep[metric]
+        cells = " ".join(f"p{q}={table.get(f'{metric}_p{q}_ms', '—')}"
+                         for q in (50, 95, 99))
+        print(f"  {metric:<6} {cells} (ms)")
+    tail = rep["tail"]
+    if tail:
+        print(f"\n== tail attribution (TTFT >= p{tail['quantile']:g} = "
+              f"{1000 * tail['cut_ttft_s']:.1f} ms, "
+              f"{tail['requests']} request(s)) ==")
+        print(f"  tail TTFT = {tail['queue_pct']}% queue + "
+              f"{tail['prefill_pct']}% own prefill + "
+              f"{tail['interleave_pct']}% prefill-behind-chunked-neighbor")
+    if rep["p99_exemplar"] is not None:
+        print("\n== slowest-TTFT exemplar waterfall ==")
+        for line in exemplar_waterfall(rep["p99_exemplar"]):
+            print(line)
+    if rep["tenants"]:
+        print("\n== per-tenant ==")
+        for name, row in rep["tenants"].items():
+            counts = " ".join(f"{k}={row[k]}" for k in
+                              ("completed", "shed", "abandoned", "failed",
+                               "tokens") if row.get(k))
+            lat = " ".join(f"{k.replace('_ms', '')}="
+                           f"{row[k]}" for k in row if k.endswith("_ms")
+                           and row[k] is not None)
+            print(f"  {name:<12} {counts}")
+            if lat:
+                print(f"  {'':<12} {lat} (ms)")
+    if rep["exemplars"]:
+        print("\n== exemplar snapshot (request_trace_exemplars.json) ==")
+        for metric, ids in rep["exemplars"].items():
+            print(f"  slowest by {metric}: {ids}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -118,6 +118,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="queue-wait SLO in ms (same breach handling)")
     p.add_argument("--capture_max", type=int, default=3,
                    help="retention cap for SLO-breach profiler captures")
+    p.add_argument("--request_trace", action="store_true",
+                   help="per-request span trees to request_trace.jsonl "
+                        "(one line per completed/shed request) + the "
+                        "slowest-K exemplar snapshot — the request "
+                        "observatory (docs/SERVING.md 'Request tracing'); "
+                        "off by default: OFF adds no per-token cost")
+    p.add_argument("--trace_exemplars", type=int, default=8,
+                   help="slowest-K requests kept with full span trees in "
+                        "request_trace_exemplars.json (--request_trace)")
     args = p.parse_args(argv)
 
     if args.platform:
@@ -182,8 +191,17 @@ def main(argv: list[str] | None = None) -> int:
                     if args.slo_ttft_ms is not None else None),
             queue_wait_s=(args.slo_queue_wait_ms / 1000.0
                           if args.slo_queue_wait_ms is not None else None))
+    reqtrace_rec = None
+    if args.request_trace:
+        from llama_pipeline_parallel_tpu.serve.reqtrace import (
+            RequestTraceRecorder,
+        )
+
+        reqtrace_rec = RequestTraceRecorder(
+            args.output_dir, exemplar_k=args.trace_exemplars)
     engine = ServeEngine(params, cfg, serve_cfg, metrics_writer=writer,
-                         timeline=tl_writer, profiler=prof, slo=slo)
+                         timeline=tl_writer, profiler=prof, slo=slo,
+                         reqtrace=reqtrace_rec)
 
     server = make_server(engine, args.host, args.port)
     port = server.server_address[1]
@@ -282,6 +300,8 @@ def main(argv: list[str] | None = None) -> int:
         writer.close()
         if tl_writer is not None:
             tl_writer.close()
+        if reqtrace_rec is not None:
+            reqtrace_rec.close()
         hb.stop()
     return 0
 

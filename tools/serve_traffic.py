@@ -42,6 +42,7 @@ class TrafficRequest:
     prompt_len: int
     max_new_tokens: int
     seed: int
+    tenant: str | None = None   # SLO class (per-tenant attribution)
 
 
 def parse_mix(spec: str) -> tuple[tuple[int, float], ...]:
@@ -68,12 +69,44 @@ def mix_label(mix: tuple[tuple[int, float], ...]) -> str:
     return ",".join(f"{n}:{round(w, 4)}" for n, w in mix)
 
 
+def parse_tenant_mix(spec: str) -> tuple[tuple[str, float], ...]:
+    """`"free:0.8,paid:0.2"` -> (("free", 0.8), ("paid", 0.2)), weights
+    normalized — the tenant counterpart of `parse_mix`. A bare `"paid"`
+    means one tenant at weight 1."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, weight = part.partition(":")
+        if not name:
+            raise ValueError(f"tenant mix {spec!r} has an empty tenant name")
+        out.append((name, float(weight) if weight else 1.0))
+    if not out:
+        raise ValueError(f"empty tenant mix {spec!r}")
+    total = sum(w for _, w in out)
+    if total <= 0 or any(w < 0 for _, w in out):
+        raise ValueError(f"tenant mix {spec!r} needs non-negative weights "
+                         f"summing > 0")
+    return tuple((name, w / total) for name, w in out)
+
+
+def tenant_mix_label(mix: tuple[tuple[str, float], ...]) -> str:
+    return ",".join(f"{name}:{round(w, 4)}" for name, w in mix)
+
+
 def poisson_trace(seed: int, rate_rps: float, n_requests: int,
-                  prompt_mix, output_mix) -> list[TrafficRequest]:
+                  prompt_mix, output_mix,
+                  tenant_mix=None) -> list[TrafficRequest]:
     """A deterministic Poisson arrival trace: exponential inter-arrival
     gaps at `rate_rps`, lengths drawn independently from the two mixes.
     Each request carries its own sampling seed (derived from the trace
-    seed), so replaying a trace is reproducible end-to-end."""
+    seed), so replaying a trace is reproducible end-to-end. `tenant_mix`
+    (parse_tenant_mix) additionally stamps each request with a weighted
+    tenant draw — all tenant draws happen AFTER the whole length/seed
+    stream, so a tenantless trace is bit-identical to one generated
+    before tenants existed and stamping tenants changes ONLY the tenant
+    field."""
     if rate_rps <= 0:
         raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
     if n_requests < 1:
@@ -85,24 +118,37 @@ def poisson_trace(seed: int, rate_rps: float, n_requests: int,
     p_w = [w for _, w in prompt_mix]
     o_lens = [n for n, _ in output_mix]
     o_w = [w for _, w in output_mix]
-    return [
-        TrafficRequest(
-            arrival_s=float(arrivals[i]),
-            prompt_len=int(rs.choice(p_lens, p=p_w)),
-            max_new_tokens=int(rs.choice(o_lens, p=o_w)),
-            seed=int(rs.randint(0, 2**31 - 1)))
-        for i in range(n_requests)
-    ]
+    draws = []
+    for i in range(n_requests):
+        prompt_len = int(rs.choice(p_lens, p=p_w))
+        max_new = int(rs.choice(o_lens, p=o_w))
+        req_seed = int(rs.randint(0, 2**31 - 1))
+        draws.append((prompt_len, max_new, req_seed))
+    if tenant_mix:
+        t_names = [name for name, _ in tenant_mix]
+        t_w = [w for _, w in tenant_mix]
+        tenants = [str(rs.choice(t_names, p=t_w))
+                   for _ in range(n_requests)]
+    else:
+        tenants = [None] * n_requests
+    return [TrafficRequest(arrival_s=float(arrivals[i]), prompt_len=pl,
+                           max_new_tokens=mn, seed=sd, tenant=tenants[i])
+            for i, (pl, mn, sd) in enumerate(draws)]
 
 
 def run_trace(engine, trace_requests, time_scale: float = 1.0,
               prompt_token_low: int = 3,
-              result_timeout_s: float = 300.0) -> dict:
+              result_timeout_s: float = 300.0,
+              collect_tokens: bool = False) -> dict:
     """Replay a trace against a live engine (a ServeLoop is started for
     the duration): submit each request at its (scaled) arrival offset,
     count refusals by kind, wait for every accepted request, and return
     the run summary. Prompt token ids are drawn deterministically from
-    the request's seed."""
+    the request's seed; a TrafficRequest's tenant is stamped onto the
+    ServeRequest, so per-tenant SLO slices and request traces attribute
+    it. `collect_tokens=True` adds `tokens` to the summary — one entry
+    per trace request, index-aligned (None for refused requests) — the
+    fixture the tracing-ON/OFF parity twin compares bit-for-bit."""
     from llama_pipeline_parallel_tpu.models.llama.decode import (
         GenerationConfig,
     )
@@ -115,11 +161,12 @@ def run_trace(engine, trace_requests, time_scale: float = 1.0,
     )
 
     vocab = engine.cfg.vocab_size
-    handles = []
+    handles = []                 # (trace index, handle)
     refused_pages = refused_overload = rejected = 0
+    submitted_by_tenant: dict[str, int] = {}
     t0 = time.monotonic()
     with ServeLoop(engine, idle_wait_s=0.002):
-        for tr in trace_requests:
+        for i, tr in enumerate(trace_requests):
             target = t0 + tr.arrival_s * time_scale
             delay = target - time.monotonic()
             if delay > 0:
@@ -129,18 +176,22 @@ def run_trace(engine, trace_requests, time_scale: float = 1.0,
             req = ServeRequest(
                 input_ids=prompt,
                 gen=GenerationConfig(max_new_tokens=tr.max_new_tokens),
-                seed=tr.seed)
+                seed=tr.seed, tenant=tr.tenant)
             try:
-                handles.append(engine.submit(req))
+                handles.append((i, engine.submit(req)))
+                if tr.tenant:
+                    submitted_by_tenant[tr.tenant] = \
+                        submitted_by_tenant.get(tr.tenant, 0) + 1
             except ServePagesExhausted:
                 refused_pages += 1
             except ServeOverloaded:
                 refused_overload += 1
             except RequestRejected:
                 rejected += 1
-        for h in handles:
+        tokens_by_index: dict[int, list] = {}
+        for i, h in handles:
             try:
-                h.result(timeout=result_timeout_s)
+                tokens_by_index[i] = h.result(timeout=result_timeout_s)
             except Exception:
                 pass  # counted via the engine's failed/rejected counters
     wall = time.monotonic() - t0
@@ -158,6 +209,14 @@ def run_trace(engine, trace_requests, time_scale: float = 1.0,
                     "tokens_generated", "prefill_chunks_total",
                     "prefill_tokens_total", "pages_total")},
     }
+    if submitted_by_tenant:
+        summary["submitted_by_tenant"] = dict(
+            sorted(submitted_by_tenant.items()))
+    if "tenants" in snap:
+        summary["tenants"] = snap["tenants"]
+    if collect_tokens:
+        summary["tokens"] = [tokens_by_index.get(i)
+                             for i in range(len(trace_requests))]
     if wall > 0:
         summary["tokens_per_sec"] = round(
             snap.get("tokens_generated", 0) / wall, 2)
@@ -174,8 +233,20 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--requests", type=int, default=32)
     p.add_argument("--prompt_mix", default="64:0.7,256:0.3")
     p.add_argument("--output_mix", default="16:0.5,64:0.5")
+    p.add_argument("--tenant_mix", default=None,
+                   help="weighted tenant mix like 'free:0.8,paid:0.2': "
+                        "stamps each generated request's tenant for "
+                        "per-tenant SLO slices and request traces")
     p.add_argument("--time_scale", type=float, default=1.0,
                    help="replay arrivals at 1/time_scale speed")
+    p.add_argument("--output_dir", default=None,
+                   help="where --request_trace artifacts land (optional "
+                        "otherwise)")
+    p.add_argument("--request_trace", action="store_true",
+                   help="attach a RequestTraceRecorder to the engine: "
+                        "request_trace.jsonl + exemplars in --output_dir "
+                        "(requires --output_dir)")
+    p.add_argument("--trace_exemplars", type=int, default=8)
     # engine shape (mirrors tools/serve.py)
     p.add_argument("--max_slots", type=int, default=8)
     p.add_argument("--max_len", type=int, default=2048)
@@ -200,23 +271,41 @@ def main(argv: list[str] | None = None) -> int:
 
     prompt_mix = parse_mix(args.prompt_mix)
     output_mix = parse_mix(args.output_mix)
+    tenant_mix = (parse_tenant_mix(args.tenant_mix)
+                  if args.tenant_mix else None)
+    if args.request_trace and not args.output_dir:
+        p.error("--request_trace requires --output_dir")
     params, cfg, _, step = load_module_checkpoint(args.checkpoint_dir,
                                                   args.step)
+    reqtrace_rec = None
+    if args.request_trace:
+        from llama_pipeline_parallel_tpu.serve.reqtrace import (
+            RequestTraceRecorder,
+        )
+
+        reqtrace_rec = RequestTraceRecorder(
+            args.output_dir, exemplar_k=args.trace_exemplars)
     engine = ServeEngine(params, cfg, ServeConfig(
         max_slots=args.max_slots, max_len=args.max_len,
         prompt_buckets=tuple(int(b) for b in args.buckets.split(",")),
         max_queue=args.max_queue, kv_cache=args.kv_cache,
         page_size=args.page_size, num_pages=args.num_pages,
         kv_quant=args.kv_quant,
-        prefill_chunk_tokens=args.prefill_chunk_tokens))
+        prefill_chunk_tokens=args.prefill_chunk_tokens),
+        reqtrace=reqtrace_rec)
     trace_requests = poisson_trace(args.seed, args.rate, args.requests,
-                                   prompt_mix, output_mix)
+                                   prompt_mix, output_mix,
+                                   tenant_mix=tenant_mix)
     summary = run_trace(engine, trace_requests, time_scale=args.time_scale)
     summary["mix"] = {"prompt": mix_label(prompt_mix),
                       "output": mix_label(output_mix),
                       "rate_rps": args.rate, "seed": args.seed}
+    if tenant_mix is not None:
+        summary["mix"]["tenant"] = tenant_mix_label(tenant_mix)
     summary["checkpoint_step"] = step
     engine.shutdown()
+    if reqtrace_rec is not None:
+        reqtrace_rec.close()
     print(json.dumps(summary, indent=2))
     return 0
 

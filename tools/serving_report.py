@@ -133,6 +133,20 @@ def main(argv: list[str] | None = None) -> int:
             if chunks:
                 print("  prefill:   " + " ".join(f"{k}={v}"
                                                  for k, v in chunks.items()))
+        tenants = last.get("tenants")
+        if isinstance(tenants, dict) and tenants:
+            # per-tenant attribution (serve/telemetry.py _TenantStats);
+            # the full per-request story lives in tools/request_report.py
+            for name in sorted(tenants):
+                snap = tenants[name]
+                if isinstance(snap, dict):
+                    cells = " ".join(
+                        f"{k}={v}" for k, v in sorted(snap.items()))
+                    print(f"  tenant {name}: {cells}")
+    if os.path.exists(os.path.join(rep["output_dir"],
+                                   "request_trace.jsonl")):
+        print("\n  per-request span trees found: render waterfalls with "
+              f"tools/request_report.py {rep['output_dir']}")
     if rep["health_goodput"] is not None:
         print(f"\n  serve goodput (health.json): "
               f"{100 * rep['health_goodput']:.1f}%")
