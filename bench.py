@@ -1092,6 +1092,107 @@ def main() -> None:
                 print(f"bench prefill traffic row failed: {e!r}",
                       file=sys.stderr, flush=True)
 
+            # Prefix-cache rows (docs/SERVING.md "Prefix caching"): the
+            # SAME 90%-shared-prefix mix replayed twice — cache on
+            # (`extra:serve-prefix-hot`) vs off (`-cold`) — so one run
+            # lands the cache-hit TTFT win as a measured delta, plus the
+            # capacity story: how many same-prefix requests a FIXED page
+            # pool admits (queued, never stepped, until 429) under page
+            # sharing vs without it. Separate try per the extras posture.
+            try:
+                from llama_pipeline_parallel_tpu.serve import ServeOverloaded
+
+                page = 16
+                tail = page
+                bucket = max(2 * page, min(64, seq) // page * page)
+                pre_len = bucket - tail
+                prefix_mix = _tr.parse_prefix_mix(
+                    f"sys{pre_len}:0.9,cold:0.1")
+                prompt_mix_p = _tr.parse_mix(f"{tail}:1.0")
+                output_mix_p = _tr.parse_mix("8:1.0")
+                rate = float(os.environ.get("BENCH_TRAFFIC_RATE", "16"))
+                n_req = int(os.environ.get("BENCH_TRAFFIC_REQUESTS", "12"))
+                pool_pages = 4 * bucket // page  # fixed, deliberately tight
+                shared = _tr.prefix_ids(f"sys{pre_len}", pre_len,
+                                        cfg.vocab_size)
+
+                def prefix_req(sd):
+                    tail_ids = np.random.RandomState(sd).randint(
+                        3, cfg.vocab_size, size=tail).tolist()
+                    return ServeRequest(
+                        input_ids=shared + tail_ids,
+                        gen=GenerationConfig(max_new_tokens=8), seed=sd)
+
+                for label, cache_on in (("hot", True), ("cold", False)):
+                    eng = ServeEngine(
+                        pl.unstack_stages(stacked, manifest), cfg,
+                        ServeConfig(max_slots=4, max_len=bucket + page,
+                                    prompt_buckets=(tail, bucket),
+                                    max_queue=4 * n_req, kv_cache="paged",
+                                    page_size=page, prefix_cache=cache_on))
+                    # pay every compile off the clock (full prefill at
+                    # both buckets, and — hot — the warm span path), and
+                    # leave the shared chain registered so the trace's
+                    # first hot request is already a hit; without this the
+                    # hot row measures XLA compiles, not the cache
+                    for wr in (prefix_req(0), prefix_req(10_000),
+                               ServeRequest(
+                                   input_ids=list(range(3, 3 + tail)),
+                                   gen=GenerationConfig(max_new_tokens=8),
+                                   seed=0)):
+                        eng.submit(wr)
+                        eng.drain(timeout_s=600)
+                    trace_reqs = _tr.poisson_trace(
+                        0, rate, n_req, prompt_mix_p, output_mix_p,
+                        prefix_mix=prefix_mix)
+                    s = _tr.run_trace(eng, trace_reqs)
+                    eng.shutdown()
+                    # admissions at a fixed pool: warm the cache with one
+                    # drained request, then queue same-prefix requests
+                    # without stepping until the pool refuses
+                    eng = ServeEngine(
+                        pl.unstack_stages(stacked, manifest), cfg,
+                        ServeConfig(max_slots=4, max_len=bucket + page,
+                                    prompt_buckets=(bucket,),
+                                    max_queue=16 * pool_pages,
+                                    kv_cache="paged", page_size=page,
+                                    num_pages=pool_pages,
+                                    prefix_cache=cache_on))
+                    eng.submit(prefix_req(1))
+                    eng.drain(timeout_s=600)
+                    admitted = 0
+                    try:
+                        for sd in range(2, 2 + 16 * pool_pages):
+                            eng.submit(prefix_req(sd))
+                            admitted += 1
+                    except ServeOverloaded:
+                        pass
+                    eng.shutdown()
+                    ttft_p50 = s.get("ttft_p50_ms")
+                    results[f"extra:serve-prefix-{label}"] = {
+                        "dt": (ttft_p50 or 0) / 1000.0,
+                        "tokens_per_step": s.get("tokens_generated", 0),
+                        "headline": False, "detail": {
+                            "mix": {"prompt": _tr.mix_label(prompt_mix_p),
+                                    "output": _tr.mix_label(output_mix_p),
+                                    "prefix": _tr.prefix_mix_label(
+                                        prefix_mix),
+                                    "rate_rps": rate, "seed": 0,
+                                    "requests": n_req},
+                            "prefix_cache": cache_on,
+                            "admitted_at_fixed_pool": admitted,
+                            "pool_pages": pool_pages, "page_size": page,
+                            **{k: s[k] for k in (
+                                "requests_completed", "refused_pages",
+                                "prefix_hits", "prefix_misses",
+                                "prefix_hit_rate", "prefix_cached_tokens",
+                                "prefix_cow_forks") if k in s},
+                            **{k: s[k] for k in s
+                               if k.startswith(("ttft_", "tpot_"))}}}
+            except Exception as e:
+                print(f"bench prefix cache rows failed: {e!r}",
+                      file=sys.stderr, flush=True)
+
     summary = report()
     watchdog.cancel()
     if summary is None:

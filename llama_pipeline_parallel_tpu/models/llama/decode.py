@@ -510,6 +510,35 @@ def reset_kv_mask_row(kv_mask: jnp.ndarray, slot: jnp.ndarray) -> jnp.ndarray:
     return jax.lax.dynamic_update_slice(kv_mask, zeros, (slot, 0))
 
 
+@partial(jax.jit, donate_argnames=("pool",))
+def copy_page(pool: dict, src: jnp.ndarray, dst: jnp.ndarray) -> dict:
+    """Clone physical page `src` into `dst` across every layer — the
+    copy-on-write fork of prefix caching (serve/pages.py): a request whose
+    prompt diverges MID-page from a cached chain copies the shared page,
+    then overwrites only the divergent suffix in its private copy. int8
+    pools bring the per-page scales along, so the copied prefix dequantizes
+    identically to the source. `src`/`dst` are traced int32 scalars: one
+    compiled program serves every fork."""
+    out = dict(pool)
+    for name in list(pool):
+        blk = jax.lax.dynamic_index_in_dim(pool[name], src, axis=1,
+                                           keepdims=True)
+        out[name] = jax.lax.dynamic_update_slice_in_dim(out[name], blk, dst,
+                                                        axis=1)
+    return out
+
+
+@jax.jit
+def set_kv_mask_row(kv_mask: jnp.ndarray, slot: jnp.ndarray,
+                    row: jnp.ndarray) -> jnp.ndarray:
+    """Rewrite logical row `slot` whole from a host-built [1, max_len] row
+    — the warm-admission counterpart of `reset_kv_mask_row`: a prefix-cache
+    hit marks its shared positions valid (and everything past them dead) in
+    ONE compiled update before the span prefill fills in the tail."""
+    return jax.lax.dynamic_update_slice(kv_mask, row.astype(kv_mask.dtype),
+                                        (slot, 0))
+
+
 def _paged_write_token(pool_k, sc_k, x1: jnp.ndarray, w_page: jnp.ndarray,
                        w_off: jnp.ndarray):
     """Scatter one token's kv rows ([b, kv_h, hd]) into their pages. int8:
@@ -679,6 +708,102 @@ def paged_prefill_chunk(params: Params, input_ids: jnp.ndarray,
             vb = quant_page_block(vb, vs[:, None, :, None])
         pk = pk.at[chunk_pages].set(kb)
         pv = pv.at[chunk_pages].set(vb)
+
+        gk, gv = _gather_pages(pk, pv, sk, sv, page_table_row[None], dt)
+        attn_out = attention(q, gk, gv, row_mask, causal=True,
+                             q_offset=write_start)
+        attn_out = attn_out.reshape(b, s, -1) @ layer["attn"]["wo"].astype(dt)
+        h = llama.mlp_block(layer, h + attn_out, cfg)
+        return h, ((pk, pv, sk, sv) if quant else (pk, pv))
+
+    x, new = jax.lax.scan(body, x, xs)
+    x = llama.final_norm(params, x[:, -1:, :], cfg)
+    logits = llama.lm_head(params, x, cfg)
+    new_pool = {"k": new[0], "v": new[1]}
+    if quant:
+        new_pool["k_scale"], new_pool["v_scale"] = new[2], new[3]
+    return {"logits": logits[:, -1], "pool": new_pool, "kv_mask": kv_mask}
+
+
+@partial(jax.jit, static_argnames=("cfg",),
+         donate_argnames=("pool", "kv_mask"))
+def paged_prefill_span(params: Params, input_ids: jnp.ndarray,
+                       attention_mask: jnp.ndarray, positions: jnp.ndarray,
+                       pool: dict, page_table_row: jnp.ndarray,
+                       slot: jnp.ndarray, kv_mask: jnp.ndarray,
+                       write_start: jnp.ndarray, cfg: LlamaConfig) -> dict:
+    """`paged_prefill_chunk` without the page-alignment constraints: prefill
+    logical span [write_start, write_start + C) of slot `slot` where
+    NEITHER the start nor the length is a page multiple — the tail a
+    prefix-cache hit recomputes from its divergence point (serve/pages.py).
+    Writes are per-token scatters into (page, offset) pairs instead of
+    whole-page blocks, so the span can begin mid-page inside a freshly
+    forked copy-on-write page and end anywhere in the bucket; attention
+    still runs each span position over the slot's FULL gathered logical row
+    (shared prefix pages + the span itself) with a causal offset. int8
+    pages follow the decode-write discipline: a page whose offset-0
+    position falls inside the span is claimed by that token's absmax,
+    earlier (copied/pre-owned) pages keep their scale and the span's writes
+    into them saturate against it. One program compiles per distinct span
+    length C (write_start is traced); the engine accepts the retrace — a
+    cache-hit tail is exactly the work the hit did NOT save."""
+    _, C = input_ids.shape
+    page = pool["k"].shape[2]
+    hd = cfg.head_dim
+    dt = cfg.dtype
+    quant = pool["k"].dtype == jnp.int8
+
+    mask = attention_mask.astype(jnp.int32)
+    kv_mask = jax.lax.dynamic_update_slice(kv_mask, mask, (slot, write_start))
+    lmax = kv_mask.shape[1]
+    row_mask = jax.lax.dynamic_slice(kv_mask, (slot, 0), (1, lmax))
+
+    w_pos = write_start + jnp.arange(C)              # [C] logical positions
+    w_page = page_table_row[w_pos // page]           # [C] physical pages
+    w_off = w_pos % page                             # [C] offsets within
+    # index (within the span) of each token's page-offset-0 position:
+    # >= 0 iff the page is CLAIMED by this span (its first position is
+    # ours to write), < 0 for the fork page the span enters mid-way
+    first_idx = w_pos - w_off - write_start          # [C] signed
+    in_span = (first_idx >= 0)[:, None]              # [C, 1]
+    first_idx = jnp.clip(first_idx, 0, C - 1)
+
+    x = llama.embed(params, input_ids, cfg)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
+                            dtype=cfg.dtype)
+    xs = ((params["layers"], pool["k"], pool["v"], pool["k_scale"],
+           pool["v_scale"]) if quant
+          else (params["layers"], pool["k"], pool["v"]))
+
+    def write(pk, sk, kv):
+        # kv: [C, kv_h, hd] — the span's freshly computed k or v rows
+        if sk is None:
+            return pk.at[w_page, w_off].set(kv), None
+        amax = _block_amax(kv, axes=-1)                        # [C, kvh]
+        # duplicate page indices in the scatter below all carry the SAME
+        # scale value (claimed pages: their offset-0 token's absmax;
+        # entered-mid-page pages: the existing scale), so write order
+        # within the scatter cannot matter
+        scale = jnp.where(in_span, amax[first_idx],
+                          jnp.maximum(sk[w_page], _SCALE_FLOOR))
+        sk = sk.at[w_page].set(scale)
+        pk = pk.at[w_page, w_off].set(quant_page_block(kv, scale[:, :, None]))
+        return pk, sk
+
+    def body(h, xs):
+        if quant:
+            layer, pk, pv, sk, sv = xs
+        else:
+            (layer, pk, pv), sk, sv = xs, None, None
+        b, s, d = h.shape
+        hidden = rms_norm(h, layer["input_norm"], cfg.rms_norm_eps)
+        q = (hidden @ layer["attn"]["wq"].astype(dt)).reshape(b, s, -1, hd)
+        k = (hidden @ layer["attn"]["wk"].astype(dt)).reshape(b, s, -1, hd)
+        v = (hidden @ layer["attn"]["wv"].astype(dt)).reshape(b, s, -1, hd)
+        q, k = apply_rope(q, k, cos, sin)
+
+        pk, sk = write(pk, sk, k[0])
+        pv, sv = write(pv, sv, v[0])
 
         gk, gv = _gather_pages(pk, pv, sk, sv, page_table_row[None], dt)
         attn_out = attention(q, gk, gv, row_mask, causal=True,

@@ -121,6 +121,12 @@ class ServeConfig:
     # 0 = whole-prompt admissions; > 0 = a bucket larger than this prefills
     # in pieces of exactly this many tokens, interleaved with decode ticks
     prefill_chunk_tokens: int = 0
+    # prefix caching (paged only; docs/SERVING.md "Prefix caching"):
+    # share physical pages between requests with identical padded prompt
+    # prefixes — cache-hit admissions skip the shared span's prefill and
+    # reserve only their new pages. Off (the default) keeps the engine
+    # byte-identical to the plain paged scheduler.
+    prefix_cache: bool = False
 
     def __post_init__(self) -> None:
         if self.decode_span_every < 1:
@@ -147,6 +153,8 @@ class ServeConfig:
             if self.prefill_chunk_tokens:
                 raise ValueError("prefill_chunk_tokens requires "
                                  "kv_cache: paged")
+            if self.prefix_cache:
+                raise ValueError("prefix_cache requires kv_cache: paged")
             return
         if self.kv_quant not in ("fp", "int8"):
             raise ValueError(f"kv_quant must be 'fp' or 'int8', got "
@@ -218,6 +226,9 @@ class RequestHandle:
         self.request = request
         self.tokens_out: list[int] = []
         self.error: Exception | None = None
+        # padded-row positions served from the prefix cache (0 = cold /
+        # cache off) — set at submit, read by traffic tooling hit-rate math
+        self.prefix_cached_tokens = 0
         self._q: queue_mod.Queue = queue_mod.Queue()
         self._done = threading.Event()
 
@@ -290,6 +301,11 @@ class _Prefilling:
     positions: np.ndarray    # [1, bucket] rope positions
     done: int                # prompt tokens prefilled so far
     t_admit: float
+    # prefix cache: the submit-time verdict (None = cache off), and
+    # whether positions [0, done) at start came from shared pages — a warm
+    # prefill recomputes only its tail via decode.paged_prefill_span
+    match: object = None
+    warm: bool = False
 
 
 class ServeEngine:
@@ -316,11 +332,12 @@ class ServeEngine:
         self.cfg = cfg
         self.serve_cfg = serve_cfg
         self._paged = serve_cfg.kv_cache == "paged"
+        self._prefix = self._paged and serve_cfg.prefix_cache
         if self._paged:
             self.slots = PagedKVCache(
                 cfg, serve_cfg.max_slots, serve_cfg.max_len,
                 serve_cfg.page_size, serve_cfg.resolved_num_pages,
-                serve_cfg.kv_quant)
+                serve_cfg.kv_quant, prefix_cache=serve_cfg.prefix_cache)
         else:
             self.slots = SlotKVCache(cfg, serve_cfg.max_slots,
                                      serve_cfg.max_len)
@@ -340,6 +357,9 @@ class ServeEngine:
         self._occupants: dict[int, _Running] = {}
         self._prefilling: deque = deque()   # paged chunked admissions
         self._queue: deque = deque()
+        # request ids the frontend saw disconnect: cancelled at the next
+        # step boundary (queued, prefilling, or decoding alike)
+        self._abandoned: set = set()
         self._closed = False
         # degraded-mode admission (docs/RESILIENCE.md "Actuation"): while
         # set (draining for a deploy restart, a mid-resize tier), submits
@@ -425,6 +445,16 @@ class ServeEngine:
             self._record_shed(request, "rejected")
             raise
         handle = RequestHandle(request)
+        ids_row = mask_row = None
+        if self._prefix:
+            # padded-row layout is fixed at submit (bucket is), so the
+            # block-hash chain can be computed here — identical to what
+            # _start_prefill will rebuild
+            pad = bucket - len(request.input_ids)
+            ids_row = np.zeros(bucket, np.int32)
+            ids_row[pad:] = np.asarray(request.input_ids, np.int32)
+            mask_row = np.zeros(bucket, np.int32)
+            mask_row[pad:] = 1
         with self._lock:
             if self._closed:  # a late submit must fail loudly, never hang
                 raise EngineShutdown("serve engine shut down")
@@ -449,7 +479,30 @@ class ServeEngine:
                 exc.retry_after_s = self._retry_after(request)
                 self._record_shed(request, "queue_full", exc.retry_after_s)
                 raise exc
-            if demand and not self.slots.reserve(demand):
+            match = None
+            if self._prefix:
+                # cache-aware admission: shared prefix pages cost 0 new
+                # pages, so the worst-case reservation shrinks by the
+                # matched chain — a fully cached prompt admits into a pool
+                # the cache-off math would have refused
+                match = self.slots.match_and_reserve(
+                    request.request_id, ids_row, mask_row, demand)
+                if match is None:
+                    self.stats.record_rejected(request.tenant)
+                    self.stats.record_page_refused()
+                    retry = self._retry_after(request)
+                    self._record_shed(request, "pages_exhausted", retry)
+                    raise ServePagesExhausted(
+                        f"free-page pool cannot cover this request's "
+                        f"worst-case demand even with prefix sharing "
+                        f"({self.slots.pages_free} free, "
+                        f"{self.slots.pages_reserved}/"
+                        f"{self.slots.num_pages} reserved) — retry after a "
+                        f"request completes", retry_after_s=retry)
+                self.stats.record_prefix(match.tokens, len(match.pages),
+                                         match.fork_src is not None)
+                handle.prefix_cached_tokens = match.tokens
+            elif demand and not self.slots.reserve(demand):
                 # refuse NOW: admitting would strand the request mid-decode
                 # when the pool runs dry under it
                 self.stats.record_rejected(request.tenant)
@@ -462,7 +515,7 @@ class ServeEngine:
                     f"{self.slots.pages_reserved}/{self.slots.num_pages} "
                     f"reserved) — retry after a request completes",
                     retry_after_s=retry)
-            self._queue.append((request, handle, demand))
+            self._queue.append((request, handle, demand, match))
         self._work.set()
         return handle
 
@@ -474,13 +527,20 @@ class ServeEngine:
             self._reqtrace.record_shed(request, reason, retry_after_s)
 
     def note_abandoned(self, request: ServeRequest) -> None:
-        """The frontend observed a client disconnect mid-stream. The
-        request keeps decoding to completion — there is no cancellation
-        protocol yet (docs/SERVING.md documents the gap) — so this only
-        bumps `requests_abandoned` and stamps a terminal `abandoned`
-        event on the request's trace (best-effort: a disconnect racing
-        the final completion write may land as a separate late record)."""
+        """The frontend observed a client disconnect mid-stream: bump
+        `requests_abandoned`, stamp the trace, and CANCEL the request at
+        the next step boundary — queued entries drop their reservation,
+        an in-flight slot is freed with its unshared pages released
+        (shared prefix pages just drop a refcount) and `tokens_discarded`
+        recorded on the abandoned trace event. Best-effort by nature: a
+        disconnect racing the final completion write may land as a
+        separate late record, and up to one more token can be decoded
+        before the boundary."""
         self.stats.record_abandoned(request.tenant)
+        with self._lock:
+            if not self._closed:
+                self._abandoned.add(request.request_id)
+        self._work.set()
         if self._reqtrace is None:
             return
         b = self._rt.get(request.request_id)
@@ -497,6 +557,7 @@ class ServeEngine:
         with one), then one decode tick over all slots. Returns False when
         there was nothing to do (caller may sleep)."""
         t0 = time.perf_counter() if self._timeline is not None else 0.0
+        self._cancel_abandoned()
         pf_req = (self._prefilling[0].request.request_id
                   if self._prefilling else None)
         self._advance_prefill()
@@ -547,6 +608,59 @@ class ServeEngine:
             rec["prefilling_request"] = pf_req
         self._timeline.write(rec)
 
+    # -- cancellation (loop thread; the PR 18 "no-cancellation gap") --------
+
+    def _cancel_abandoned(self) -> None:
+        """Cancel every request the frontend flagged abandoned since the
+        last boundary: queued entries return their page reservation (and
+        release their prefix-match pins), a mid-prefill or decoding slot is
+        freed — unshared pages back to the pool, shared prefix pages drop
+        one refcount — and the trace ends as `abandoned` with the token
+        count the client never consumed. No SLO record: the request has no
+        honest completion latency."""
+        if not self._abandoned:
+            return
+        with self._lock:
+            doomed = self._abandoned
+            self._abandoned = set()
+            kept: deque = deque()
+            queued = []
+            while self._queue:
+                entry = self._queue.popleft()
+                (queued if entry[0].request_id in doomed
+                 else kept).append(entry)
+            self._queue = kept
+        for request, handle, demand, match in queued:
+            if match is not None:
+                self.slots.cancel_match(match)
+            elif demand:
+                self.slots.unreserve(demand)
+            self._finish_abandoned(request, handle, discarded=0)
+        for pf in [p for p in self._prefilling
+                   if p.request.request_id in doomed]:
+            self._prefilling.remove(pf)
+            if (pf.match is not None and pf.match.fork_src is not None
+                    and not pf.match.forked):
+                self.slots.unpin_page(pf.match.fork_src)
+            self.slots.release(pf.slot)
+            self._finish_abandoned(pf.request, pf.handle,
+                                   discarded=len(pf.handle.tokens_out))
+        for slot, r in [(s, r) for s, r in self._occupants.items()
+                        if r.request.request_id in doomed]:
+            self._occupants.pop(slot)
+            self.slots.release(slot)
+            self._finish_abandoned(r.request, r.handle, discarded=r.emitted)
+
+    def _finish_abandoned(self, request: ServeRequest, handle: RequestHandle,
+                          discarded: int) -> None:
+        if self._reqtrace is not None:
+            b = self._rt.pop(request.request_id, None)
+            if b is not None:
+                self._reqtrace.write(b.build(
+                    "abandoned", time.time(), tokens=len(handle.tokens_out),
+                    tokens_discarded=discarded))
+        handle._finish(None)
+
     # -- admission: the ONE prefill path for both caches -------------------
 
     def _advance_prefill(self) -> None:
@@ -572,7 +686,14 @@ class ServeEngine:
                 if pf is None:     # start failed; its handle already failed
                     continue
                 self._prefilling.append(pf)
-            cost = pf.bucket if not chunk or pf.bucket <= chunk else chunk
+            if pf.warm:
+                # only the tail past the cached prefix costs prefill work;
+                # its length is not chunk-aligned, so the last (often only)
+                # span is whatever remains
+                remaining = pf.bucket - pf.done
+                cost = remaining if not chunk else min(chunk, remaining)
+            else:
+                cost = pf.bucket if not chunk or pf.bucket <= chunk else chunk
             if chunk and spent + cost > chunk:
                 break              # budget for this tick is spent
             try:
@@ -604,15 +725,20 @@ class ServeEngine:
         with self._lock:
             if not self._queue:
                 return None
-            request, handle, demand = self._queue[0]
-            slot = self.slots.acquire(request.request_id, demand)
+            request, handle, demand, match = self._queue[0]
+            if match is None:   # dense, or paged with the cache off
+                slot = self.slots.acquire(request.request_id, demand)
+            else:
+                slot = self.slots.acquire(request.request_id,
+                                          match.new_demand, match=match)
             if slot is None:
                 return None
             self._queue.popleft()
-        return request, handle, slot, demand
+        return request, handle, slot, demand, match
 
     def _start_prefill(self, request: ServeRequest, handle: RequestHandle,
-                       slot: int, demand: int) -> "_Prefilling | None":
+                       slot: int, demand: int,
+                       match=None) -> "_Prefilling | None":
         try:
             gen = request.gen
             t_admit = time.time()
@@ -629,19 +755,41 @@ class ServeEngine:
             positions = np.clip(np.cumsum(mask, axis=1) - 1, 0,
                                 None).astype(np.int32)
             chunk = self.serve_cfg.prefill_chunk_tokens
-            if self._paged and chunk and bucket > chunk:
+            warm = match is not None and match.tokens > 0
+            if warm:
+                # prefix-cache hit: positions [0, match.tokens) are served
+                # by shared pages already mapped into the slot's table row
+                # by acquire() — mark them valid (and everything past them
+                # dead) in one row rewrite, fork the divergence page
+                # copy-on-write when the split lands mid-page, and start
+                # the prefill clock at the divergence point
+                self.slots.set_mask_row_prefix(slot, mask[0], match.tokens)
+                if match.fork_src is not None:
+                    self.slots.fork_page(slot, match.fork_src)
+                    match.forked = True
+                    self.slots.unpin_page(match.fork_src)
+            elif self._paged and chunk and bucket > chunk:
                 # incremental writes: the previous occupant's mask must die
                 self.slots.reset_mask_row(slot)
             if self._reqtrace is not None:
                 b = self._reqtrace.begin(request)
-                b.admitted(t_admit, slot, bucket, demand)
+                b.admitted(t_admit, slot, bucket,
+                           demand if match is None else match.new_demand)
+                if warm:
+                    b.prefix_hit(match.tokens, len(match.pages),
+                                 match.fork_src is not None)
                 self._rt[request.request_id] = b
             return _Prefilling(request=request, handle=handle, slot=slot,
                                bucket=bucket, ids=ids, mask=mask,
-                               positions=positions, done=0, t_admit=t_admit)
+                               positions=positions,
+                               done=match.tokens if warm else 0,
+                               t_admit=t_admit, match=match, warm=warm)
         except Exception as e:
             logger.exception("admission of %s failed", request.request_id)
             self.stats.record_failed(request.tenant)
+            if (match is not None and match.fork_src is not None
+                    and not match.forked):
+                self.slots.unpin_page(match.fork_src)
             self.slots.release(slot)
             self._rt.pop(request.request_id, None)
             self._record_shed(request, "admission_failed")
@@ -658,7 +806,26 @@ class ServeEngine:
         with trace.span("serve_prefill", request=pf.request.request_id,
                         bucket=pf.bucket, slot=slot, chunk=cost,
                         offset=pf.done) as sp:
-            if cost == pf.bucket:
+            if pf.warm:
+                # prefix-cache tail: recompute only [done, done + cost) —
+                # start and length are divergence-determined, not
+                # page-aligned, so the span kernel scatters per-token into
+                # the slot's (possibly just-forked) pages
+                c0, c1 = pf.done, pf.done + cost
+                self.slots.ensure_capacity(slot, c1)
+                out = decode.paged_prefill_span(
+                    self.params, jnp.asarray(pf.ids[:, c0:c1]),
+                    jnp.asarray(pf.mask[:, c0:c1]),
+                    jnp.asarray(pf.positions[:, c0:c1]), self.slots.pool,
+                    jnp.asarray(self.slots.page_table[slot]),
+                    jnp.int32(slot), self.slots.kv_mask, jnp.int32(c0),
+                    self.cfg)
+                self.slots.pool = out["pool"]
+                self.slots.kv_mask = out["kv_mask"]
+                logits = out["logits"]
+                next_pos = int(pf.positions[0, -1]) + 1
+                pf.done = c1
+            elif cost == pf.bucket:
                 # single shot; the prefill logits depend only on the prompt
                 # block, so the row capacity (dense: the whole max_len row
                 # write_slot splices; paged: the bucket write_pages pages)
@@ -687,6 +854,12 @@ class ServeEngine:
                 next_pos = int(pf.positions[0, -1]) + 1
                 pf.done = c1
             if pf.done >= pf.bucket:
+                if self._prefix and pf.match is not None:
+                    # index the freshly written prompt pages so later
+                    # requests can map them; registered pages survive this
+                    # slot's release as cached pages
+                    self.slots.register_prefix(slot, pf.match.hashes,
+                                               pf.ids[0], pf.mask[0])
                 gen = pf.request.gen
                 chain, first_key = jax.random.split(
                     jax.random.PRNGKey(pf.request.seed))
@@ -918,6 +1091,14 @@ class ServeEngine:
             snap["prefill_chunks_last_tick"] = self.prefill_chunks_last_tick
             snap["prefill_chunks_total"] = self.prefill_chunks_total
             snap["prefill_tokens_total"] = self.prefill_tokens_total
+            if self._prefix:
+                # cache-off snapshots stay byte-identical to the plain
+                # paged engine (the PR 13 pin) — these keys only exist
+                # when prefix caching is on
+                snap["prefix_cache"] = 1
+                snap["pages_cached"] = self.slots.pages_cached
+                snap["prefix_cow_forks"] = self.slots.cow_forks
+                snap["prefix_evictions"] = self.slots.prefix_evictions
         return snap
 
     def drain(self, timeout_s: float = 60.0) -> None:
@@ -940,13 +1121,18 @@ class ServeEngine:
             self._closed = True
             pending = list(self._queue)
             self._queue.clear()
-        for request, handle, demand in pending:
-            if demand:
+        for request, handle, demand, match in pending:
+            if match is not None:
+                self.slots.cancel_match(match)
+            elif demand:
                 self.slots.unreserve(demand)
             self._record_shed(request, "shutdown")
             handle._finish(err)
         while self._prefilling:
             pf = self._prefilling.popleft()
+            if (pf.match is not None and pf.match.fork_src is not None
+                    and not pf.match.forked):
+                self.slots.unpin_page(pf.match.fork_src)
             self.slots.release(pf.slot)
             self._write_failed_trace(pf.request, len(pf.handle.tokens_out))
             pf.handle._finish(err)

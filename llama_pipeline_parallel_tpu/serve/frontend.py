@@ -24,8 +24,9 @@ Tracing contract (docs/SERVING.md "Request tracing"): an incoming W3C
 headers mint a fresh trace, never a 400); every response that decoded a
 request — 200, 429, 400, 503 — carries `X-Request-Id`, `X-Trace-Id`, and
 a `traceparent` response header. A client disconnect mid-stream bumps
-`requests_abandoned` and stamps the request trace; the request still
-decodes to completion — no cancellation protocol yet.
+`requests_abandoned`, stamps the request trace, and cancels the request
+at the engine's next step boundary — its slot and unshared pages are
+freed, shared prefix pages drop a refcount.
 
 Backpressure maps to status codes: ServeOverloaded -> 429 with a
 Retry-After header (wait queue full, or — its ServePagesExhausted
@@ -188,9 +189,9 @@ class _Handler(BaseHTTPRequestHandler):
             tail = {"done": True, "request_id": request.request_id,
                     "trace_id": trace_id, "tokens": handle.tokens_out}
         except OSError:
-            # client hung up mid-stream; the request itself keeps running
-            # to completion (no cancellation protocol yet, docs/SERVING.md
-            # records the gap) — count the abandonment, stop writing
+            # client hung up mid-stream: count the abandonment and tell the
+            # engine — it cancels the request at the next step boundary,
+            # freeing the slot and its pages for paying traffic
             logger.debug("client disconnected during stream of %s",
                          request.request_id)
             self.engine.note_abandoned(request)

@@ -22,15 +22,39 @@ written:
   a small int32 array each tick — page residency changes never recompile
   anything.
 
+With `prefix_cache=True` (docs/SERVING.md "Prefix caching") physical pages
+become SHAREABLE: every prompt is chain-hashed in page_size blocks of its
+PADDED row (ids AND mask — a page's bytes depend on the whole padded
+layout, so only element-identical rows share), a host-side prefix index
+maps block-hash chains to physical pages, and `match_and_reserve` lets a
+submit walk the longest cached chain, pin those pages, and reserve only the
+NEW pages past the divergence point. The engine maps the pinned pages into
+the slot's table row (a numpy edit — no kernel change, reads already
+tolerate any mapping), recomputes only the tail, and registers the freshly
+written prompt pages back into the index at prefill completion. Divergence
+mid-page forks the containing page copy-on-write (`decode.copy_page`);
+decode writes never touch shared pages (write_pos starts at the
+page-aligned bucket, so the first decode write always claims a fresh
+page). Every page holds a refcount while mapped/pinned; refcount-0 cached
+pages sit on an LRU and are EVICTED (with their now-unreachable index
+subtree) before an allocation would fail — the committed-pages invariant
+`queued + slot_reserved + held_cached <= num_pages` keeps admitted
+requests infallible exactly as before.
+
 The interface mirrors `SlotKVCache` (acquire/admit/release/active_count/
 assignments/allocations) so `ServeEngine` and tools/serve.py treat either
 cache uniformly; the paged extras (reserve/ensure_capacity/page gauges)
-only the paged scheduler touches.
+only the paged scheduler touches, and every prefix-cache structure is
+empty/byte-identical-in-behavior when `prefix_cache` is off (the PR 13
+pin).
 """
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
 import threading
+from collections import OrderedDict
 
 import jax.numpy as jnp
 import numpy as np
@@ -68,9 +92,67 @@ def paged_pool_bytes(cfg: LlamaConfig, num_pages: int, page_size: int,
     return kv
 
 
+def chain_hashes(ids_row: np.ndarray, mask_row: np.ndarray,
+                 page_size: int) -> list:
+    """One chain hash per page_size block of the PADDED row: h_i =
+    H(h_{i-1} || ids_block || mask_block). KV at row position j is a pure
+    function of row content [0, j] (pads are masked out of attention but
+    written deterministically), so an equal chain hash means bit-equal page
+    bytes for same-kernel writers — the sharing criterion. Hashing the mask
+    alongside the ids is what makes pad-layout differences (same prompt,
+    different bucket alignment) correctly NOT share."""
+    n = len(ids_row) // page_size
+    out = []
+    h = b""
+    for i in range(n):
+        s = slice(i * page_size, (i + 1) * page_size)
+        h = hashlib.blake2b(
+            h + np.ascontiguousarray(ids_row[s]).tobytes()
+            + np.ascontiguousarray(mask_row[s]).tobytes(),
+            digest_size=16).digest()
+        out.append(h)
+    return out
+
+
+class _PrefixNode:
+    """One cached prompt block: its chain hash, the physical page holding
+    its KV, the tree edges (parent/children — eviction must drop a node's
+    now-unreachable subtree), and the block CONTENT (ids + mask), kept so
+    a divergent request can find the child with the longest common token
+    prefix and fork its page copy-on-write."""
+
+    __slots__ = ("key", "page", "parent", "children", "ids", "mask")
+
+    def __init__(self, key: bytes, page: int, parent, ids, mask):
+        self.key = key
+        self.page = page
+        self.parent = parent
+        self.children: dict = {}
+        self.ids = ids
+        self.mask = mask
+
+
+@dataclasses.dataclass
+class PrefixMatch:
+    """A submit-time cache verdict: positions [0, tokens) of the padded row
+    are served by `pages` (fully shared, pinned) plus — when the divergence
+    point is mid-page — a copy-on-write fork of `fork_src` for positions
+    [len(pages) * page_size, tokens). `new_demand` pages were reserved on
+    top; `hashes` carries the full block-hash chain for registration at
+    prefill completion."""
+
+    tokens: int
+    pages: list
+    hashes: list
+    fork_src: int | None
+    new_demand: int
+    forked: bool = False   # engine bookkeeping: fork pin already released
+
+
 class PagedKVCache:
     def __init__(self, cfg: LlamaConfig, max_slots: int, max_len: int,
-                 page_size: int, num_pages: int, quant: str = "fp"):
+                 page_size: int, num_pages: int, quant: str = "fp",
+                 prefix_cache: bool = False):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if page_size < 1:
@@ -90,6 +172,7 @@ class PagedKVCache:
         self.page_size = page_size
         self.num_pages = num_pages
         self.quant = quant
+        self.prefix_cache = prefix_cache
         self.pages_per_slot = max_len // page_size
         self.garbage_page = num_pages
 
@@ -107,6 +190,17 @@ class PagedKVCache:
         self._queued_reserved = 0      # race-safe for lock-free gauges;
         # pages promised to still-queued requests — iterating the dict from
         # another thread would not be)
+        self._owned_total = 0          # pages backing slot reservations
+        # -- prefix cache (all empty forever when prefix_cache is off) ------
+        self._index: dict[bytes, _PrefixNode] = {}   # chain hash -> node
+        self._root = _PrefixNode(b"", -1, None, None, None)
+        self._page_node: dict[int, _PrefixNode] = {}  # page -> its node
+        self._ref: dict[int, int] = {}  # page -> mappings + submit pins
+        self._idle: "OrderedDict[int, None]" = OrderedDict()  # ref-0 LRU
+        self._shared: dict[int, list[int]] = {}  # slot -> mapped front pages
+        self._held = 0                 # distinct non-owned pages with ref>=1
+        self.cow_forks = 0             # cumulative copy-on-write forks
+        self.prefix_evictions = 0      # index nodes dropped by LRU eviction
         self.assignments: list[tuple[int, str]] = []
         self.allocations = 1          # the pool is allocated ONCE
         self.page_allocations = 0     # cumulative page hand-outs (reuse proof)
@@ -133,18 +227,34 @@ class PagedKVCache:
 
     @property
     def pages_used(self) -> int:
+        """Physically allocated pages, each counted ONCE no matter how many
+        slot rows map it (shared prefix pages included — they hold live
+        KV); idle cached pages count too until eviction frees them."""
         return self.num_pages - len(self._free_pages)
 
     @property
+    def pages_cached(self) -> int:
+        """Pages registered in the prefix index (shared-held + idle)."""
+        return len(self._page_node)
+
+    @property
     def pages_reserved(self) -> int:
+        """Pages promised to queued + admitted requests. Under prefix
+        sharing this counts only NEW pages (shared pages cost 0 — the
+        cache-aware admission math), which with the cache off is every
+        page, exactly the PR 13 number."""
         return self._queued_reserved + self._slot_reserved_total
 
     @property
     def reserved_unbacked(self) -> int:
         """Pages promised (admission control) but not yet physically
-        allocated — the reservation-vs-allocation gap. Every backed page
-        counts against some slot's reservation, so this is never negative."""
-        return max(self.pages_reserved - self.pages_used, 0)
+        allocated — the reservation-vs-allocation gap. Counted against the
+        pages actually backing reservations (`_owned_total`), NOT raw pool
+        occupancy: a shared prefix page backs no reservation and must not
+        hide the gap (refcount-aware; identical to used-based accounting
+        when nothing is cached). Every backed page counts against some
+        slot's reservation, so this is never negative."""
+        return max(self.pages_reserved - self._owned_total, 0)
 
     @property
     def fragmentation(self) -> float:
@@ -168,7 +278,7 @@ class PagedKVCache:
     def fragmentation_gauges(self) -> dict:
         """The page-pool occupancy snapshot `/healthz` and the serve
         timeline publish each tick."""
-        return {
+        out = {
             "pages_free": self.pages_free,
             "pages_used": self.pages_used,
             "pages_reserved": self.pages_reserved,
@@ -176,18 +286,30 @@ class PagedKVCache:
             "fragmentation": round(self.fragmentation, 4),
             "reserved_gap_bytes": self.reserved_unbacked * self.page_bytes(),
         }
+        if self.prefix_cache:
+            out["pages_cached"] = self.pages_cached
+        return out
 
     def demand_pages(self, bucket: int, max_new_tokens: int) -> int:
         return page_demand(bucket, max_new_tokens, self.page_size)
 
     # -- reservation (admission control; any thread) -----------------------
 
+    def _committed_locked(self) -> int:
+        """Pages the pool is committed to: reservations (queued + per-slot)
+        plus cached pages currently HELD by a mapping or pin — everything
+        that is not free-or-evictable. `committed <= num_pages` is the
+        invariant that keeps `_alloc_page_locked` infallible for admitted
+        requests; with the prefix cache off `_held` is always 0 and this
+        is exactly the PR 13 reservation check."""
+        return self._queued_reserved + self._slot_reserved_total + self._held
+
     def reserve(self, n: int) -> bool:
         """Commit `n` pages to a not-yet-admitted request; False when the
         pool cannot cover it on top of everything already promised — the
         refusal signal, instead of admitting and failing mid-decode."""
         with self._lock:
-            if self.pages_reserved + n > self.num_pages:
+            if self._committed_locked() + n > self.num_pages:
                 return False
             self._queued_reserved += n
             return True
@@ -199,11 +321,233 @@ class PagedKVCache:
                                  f"{self._queued_reserved}")
             self._queued_reserved -= n
 
+    # -- prefix cache: match / pin / register / evict -----------------------
+
+    def _pin_locked(self, page: int) -> None:
+        r = self._ref.get(page, 0)
+        if r == 0:
+            self._held += 1
+            self._idle.pop(page, None)
+        self._ref[page] = r + 1
+
+    def _unpin_locked(self, page: int) -> None:
+        r = self._ref[page] - 1
+        if r:
+            self._ref[page] = r
+            return
+        del self._ref[page]
+        self._held -= 1
+        if page in self._page_node:
+            self._idle[page] = None        # most-recently-used LRU end
+        else:
+            # de-indexed (an evicted subtree) while still held: the last
+            # mapping just dropped — straight back to the free list
+            self._free_pages.append(page)
+            self._free_pages.sort(reverse=True)
+
+    def unpin_page(self, page: int) -> None:
+        """Release one hold on a cached page (the engine's fork-source
+        release once `decode.copy_page` has run)."""
+        with self._lock:
+            self._unpin_locked(page)
+
+    def _alloc_page_locked(self) -> int:
+        if not self._free_pages:
+            self._evict_lru_locked()
+        return self._free_pages.pop()
+
+    def _evict_lru_locked(self) -> None:
+        """Free at least one page by evicting the least-recently-idle
+        cached page AND de-indexing its subtree (descendants hang off the
+        evicted chain hash — unreachable once it is gone). Subtree pages
+        still held by live mappings lose cached status and return to the
+        free list when their last hold drops; idle ones free now. The
+        committed invariant guarantees this is only ever called when
+        something IS evictable."""
+        if not self._idle:
+            raise RuntimeError(
+                "page pool empty with nothing evictable — committed-pages "
+                "accounting bug")
+        page, _ = self._idle.popitem(last=False)   # least recently idle
+        node = self._page_node[page]
+        if node.parent is not None:
+            node.parent.children.pop(node.key, None)
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            n.children = {}
+            self._index.pop(n.key, None)
+            self._page_node.pop(n.page, None)
+            self.prefix_evictions += 1
+            if self._ref.get(n.page, 0) == 0:
+                self._idle.pop(n.page, None)
+                self._free_pages.append(n.page)
+        self._free_pages.sort(reverse=True)
+
+    def match_and_reserve(self, request_id: str, ids_row: np.ndarray,
+                          mask_row: np.ndarray,
+                          demand: int) -> PrefixMatch | None:
+        """The cache-aware admission check: walk the longest cached chain
+        for this padded row, PIN the matched pages (a hold that keeps them
+        from evicting between submit and admission), pick a copy-on-write
+        fork source when the divergence lands mid-page, and reserve only
+        the remaining new-page demand. Returns None — with every pin
+        undone — when the pool cannot cover the new demand (the 429
+        refusal, now sharing-aware: a fully cached prompt costs ~0 new
+        pages)."""
+        ids_row = np.ascontiguousarray(np.asarray(ids_row,
+                                                  np.int32).reshape(-1))
+        mask_row = np.ascontiguousarray(np.asarray(mask_row,
+                                                   np.int32).reshape(-1))
+        ps = self.page_size
+        hashes = chain_hashes(ids_row, mask_row, ps)
+        nblocks = len(hashes)
+        bucket = len(ids_row)
+        with self._lock:
+            matched = 0
+            while matched < nblocks and hashes[matched] in self._index:
+                matched += 1
+            fork_src = None
+            if matched == nblocks:
+                # full-row match: at least one position must recompute so
+                # the engine can sample the first token — fork the last
+                # page and recompute exactly position bucket-1
+                matched -= 1
+                tokens = bucket - 1
+                if tokens % ps:
+                    fork_src = self._index[hashes[matched]].page
+            else:
+                tokens = matched * ps
+                parent = (self._index[hashes[matched - 1]] if matched
+                          else self._root)
+                s = slice(matched * ps, (matched + 1) * ps)
+                blk_ids, blk_mask = ids_row[s], mask_row[s]
+                best = 0
+                for child in parent.children.values():
+                    same = (child.ids == blk_ids) & (child.mask == blk_mask)
+                    c = ps if same.all() else int(np.argmin(same))
+                    c = min(c, ps - 1)  # a full block match would have
+                    if c > best:        # matched by hash; cap defensively
+                        best, fork_src = c, child.page
+                if fork_src is not None:
+                    tokens += best
+
+            pinned = [self._index[hashes[i]].page for i in range(matched)]
+            for p in pinned:
+                self._pin_locked(p)
+            if fork_src is not None:
+                self._pin_locked(fork_src)
+            new_demand = demand - matched
+            if self._committed_locked() + new_demand > self.num_pages:
+                for p in pinned:
+                    self._unpin_locked(p)
+                if fork_src is not None:
+                    self._unpin_locked(fork_src)
+                return None
+            self._queued_reserved += new_demand
+        return PrefixMatch(tokens=tokens, pages=pinned, hashes=hashes,
+                           fork_src=fork_src, new_demand=new_demand)
+
+    def cancel_match(self, match: PrefixMatch) -> None:
+        """A match that will never be admitted (queue drop, shutdown,
+        abandoned while queued): release the submit-time pins and its
+        reservation."""
+        with self._lock:
+            for p in match.pages:
+                self._unpin_locked(p)
+            if match.fork_src is not None and not match.forked:
+                self._unpin_locked(match.fork_src)
+            if match.new_demand > self._queued_reserved:
+                raise ValueError(
+                    f"cancel_match({match.new_demand}) exceeds queued "
+                    f"reservation {self._queued_reserved}")
+            self._queued_reserved -= match.new_demand
+
+    def fork_page(self, slot: int, src: int) -> None:
+        """Copy-on-write fork: allocate the slot's next page and clone the
+        cached source page into it, so the span prefill can overwrite only
+        the divergent suffix. The caller (engine) unpins `src` afterwards;
+        the clone is a plain owned page until registration."""
+        base = len(self._shared.get(slot, ()))
+        self.ensure_capacity(slot, base * self.page_size + 1)
+        dst = int(self.page_table[slot, base])
+        self.pool = decode.copy_page(self.pool, jnp.int32(src),
+                                     jnp.int32(dst))
+        self.cow_forks += 1
+
+    def register_prefix(self, slot: int, hashes: list, ids_row: np.ndarray,
+                        mask_row: np.ndarray) -> int:
+        """Index the slot's freshly prefilled prompt pages under their
+        chain hashes so later requests can map them read-only. Registered
+        pages move from the slot's owned list to its shared mapping (ref 1
+        — the slot's own hold; their reservation is spent, and they
+        survive `release` as cached pages). A block whose hash landed in
+        the index while this prompt prefilled adopts the canonical page
+        and frees its private twin instead (identical content by the chain
+        property). Returns how many new blocks were registered."""
+        if not self.prefix_cache:
+            return 0
+        ps = self.page_size
+        ids_row = np.asarray(ids_row, np.int32).reshape(-1)
+        mask_row = np.asarray(mask_row, np.int32).reshape(-1)
+        with self._lock:
+            shared = self._shared.setdefault(slot, [])
+            owned = self._owned[slot]
+            parent = self._root
+            registered = 0
+            resort = False
+            for i, key in enumerate(hashes):
+                node = self._index.get(key)
+                if i < len(shared) and (node is None or node.page
+                                        != shared[i]):
+                    # a mapped prefix page was de-indexed mid-flight (an
+                    # idle ancestor's eviction cascaded): the chain above
+                    # is gone, deeper registrations would be unreachable
+                    break
+                if i < len(shared):
+                    parent = node
+                    continue
+                if node is not None:
+                    dup = owned.pop(0)
+                    self._free_pages.append(dup)
+                    resort = True
+                    self._owned_total -= 1
+                    self._pin_locked(node.page)
+                    self.page_table[slot, i] = node.page
+                    shared.append(node.page)
+                    self._slot_reserved[slot] -= 1
+                    self._slot_reserved_total -= 1
+                    parent = node
+                    continue
+                s = slice(i * ps, (i + 1) * ps)
+                page = owned.pop(0)
+                node = _PrefixNode(key, page, parent, ids_row[s].copy(),
+                                   mask_row[s].copy())
+                parent.children[key] = node
+                self._index[key] = node
+                self._page_node[page] = node
+                self._ref[page] = 1        # the slot's own mapping
+                self._held += 1
+                self._owned_total -= 1
+                shared.append(page)
+                self._slot_reserved[slot] -= 1
+                self._slot_reserved_total -= 1
+                parent = node
+                registered += 1
+            if resort:
+                self._free_pages.sort(reverse=True)
+        return registered
+
     # -- lifecycle (the engine loop thread) --------------------------------
 
-    def acquire(self, request_id: str, reserved_pages: int) -> int | None:
+    def acquire(self, request_id: str, reserved_pages: int,
+                match: PrefixMatch | None = None) -> int | None:
         """A free slot carrying the request's page reservation (moved from
-        the queued pot), or None when every slot is occupied."""
+        the queued pot), or None when every slot is occupied. With a
+        `match`, the submit-time pins become the slot's read-only mappings:
+        the shared pages land at the FRONT of the table row, owned pages
+        fill in behind them."""
         with self._lock:
             if not self._free_slots:
                 return None
@@ -212,26 +556,36 @@ class PagedKVCache:
             self._slot_reserved[slot] = reserved_pages
             self._slot_reserved_total += reserved_pages
             self._owned[slot] = []
+            if match is not None and match.pages:
+                self._shared[slot] = list(match.pages)
+                self.page_table[slot, :len(match.pages)] = match.pages
+            else:
+                self._shared[slot] = []
             self.assignments.append((slot, request_id))
             return slot
 
     def ensure_capacity(self, slot: int, tokens: int) -> int:
         """Allocate physical pages until logical positions [0, tokens) are
-        backed; returns how many pages were newly allocated. Infallible for
-        admitted requests (`tokens` within the reservation); anything past
-        it is a scheduler bug and raises."""
+        backed; returns how many pages were newly allocated. Shared prefix
+        pages already back the row's front, so only the gap past them
+        allocates. Infallible for admitted requests (`tokens` within the
+        reservation + mapping); anything past it is a scheduler bug and
+        raises."""
         need = -(-tokens // self.page_size)
         with self._lock:
             owned = self._owned[slot]
-            if need > self._slot_reserved[slot]:
+            base = len(self._shared.get(slot, ()))
+            if need - base > self._slot_reserved[slot]:
                 raise RuntimeError(
-                    f"slot {slot} needs {need} pages but reserved only "
-                    f"{self._slot_reserved[slot]} — page accounting bug")
+                    f"slot {slot} needs {need - base} new pages but "
+                    f"reserved only {self._slot_reserved[slot]} — page "
+                    f"accounting bug")
             grew = 0
-            while len(owned) < need:
-                page = self._free_pages.pop()  # cannot fail: reserved <= pool
-                self.page_table[slot, len(owned)] = page
+            while base + len(owned) < need:
+                page = self._alloc_page_locked()  # free, or evict-then-pop
+                self.page_table[slot, base + len(owned)] = page
                 owned.append(page)
+                self._owned_total += 1
                 self.page_allocations += 1
                 grew += 1
         if grew and self.alloc_listener is not None:
@@ -242,7 +596,11 @@ class PagedKVCache:
         with self._lock:
             if slot in self._free_slots or not 0 <= slot < self.max_slots:
                 raise ValueError(f"release of slot {slot} not currently held")
-            self._free_pages.extend(self._owned.pop(slot, ()))
+            for page in self._shared.pop(slot, ()):
+                self._unpin_locked(page)
+            freed = self._owned.pop(slot, ())
+            self._free_pages.extend(freed)
+            self._owned_total -= len(freed)
             self._free_pages.sort(reverse=True)   # keep lowest-first reuse
             self.page_table[slot, :] = self.garbage_page
             self._slot_reserved_total -= self._slot_reserved.pop(slot, 0)
@@ -266,6 +624,17 @@ class PagedKVCache:
         """Kill the previous occupant's logical mask before a CHUNKED
         prefill starts writing the row incrementally."""
         self.kv_mask = decode.reset_kv_mask_row(self.kv_mask, jnp.int32(slot))
+
+    def set_mask_row_prefix(self, slot: int, mask_row: np.ndarray,
+                            tokens: int) -> None:
+        """Warm admission: mark the shared positions [0, tokens) valid per
+        the request's own mask and everything past them dead, in one
+        compiled row rewrite — the prefix-cache counterpart of
+        `reset_mask_row` (the span prefill fills in the tail)."""
+        row = np.zeros((1, self.max_len), np.int32)
+        row[0, :tokens] = np.asarray(mask_row, np.int32).reshape(-1)[:tokens]
+        self.kv_mask = decode.set_kv_mask_row(self.kv_mask, jnp.int32(slot),
+                                              jnp.asarray(row))
 
     def update_from_step(self, step_out: dict) -> None:
         """Adopt the pool/kv_mask a `paged_decode_step` returned (inputs

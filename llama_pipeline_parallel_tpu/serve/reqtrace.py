@@ -99,7 +99,8 @@ class RequestTraceBuilder:
                  "tenant", "seed", "arrival", "spans", "slot", "bucket",
                  "pages_reserved", "pages_allocated", "first_tick",
                  "last_tick", "ticks", "shared_with", "t_admit", "t_first",
-                 "abandoned_at")
+                 "abandoned_at", "prefix_tokens", "prefix_pages",
+                 "prefix_cow")
 
     def __init__(self, request) -> None:
         ctx = request.trace
@@ -122,6 +123,9 @@ class RequestTraceBuilder:
         self.t_admit: float | None = None
         self.t_first: float | None = None
         self.abandoned_at: float | None = None
+        self.prefix_tokens = 0     # padded-row positions served from cache
+        self.prefix_pages = 0      # shared pages mapped at admission
+        self.prefix_cow = False    # divergence mid-page: a CoW fork ran
 
     # -- lifecycle events (engine loop thread) -----------------------------
 
@@ -138,6 +142,18 @@ class RequestTraceBuilder:
                            "pages_reserved": pages_reserved,
                            "verdict": ("reserved" if pages_reserved
                                        else "dense")})
+
+    def prefix_hit(self, tokens: int, pages: int, cow: bool) -> None:
+        """Prefix-cache hit at admission: `tokens` padded-row positions
+        came from `pages` shared pages (plus a copy-on-write fork when the
+        divergence landed mid-page) with ZERO prefill work — the span the
+        TTFT decomposition credits to `prefix_cache_hit`."""
+        self.prefix_tokens = tokens
+        self.prefix_pages = pages
+        self.prefix_cow = cow
+        self.spans.append({"name": "prefix_cache_hit", "ts": self.t_admit,
+                           "tokens": tokens, "pages": pages,
+                           "cow": bool(cow)})
 
     def prefill_chunk(self, ts: float, dur: float, offset: int,
                       tokens: int, tick: int) -> None:
@@ -166,9 +182,10 @@ class RequestTraceBuilder:
                            "pages": pages})
 
     def mark_abandoned(self, ts: float) -> None:
-        """Client hung up mid-stream (frontend OSError path). The request
-        keeps decoding to completion — no cancellation protocol yet — so
-        this is a terminal EVENT on the trace, not an outcome."""
+        """Client hung up mid-stream (frontend OSError path). The engine
+        cancels the request at the next step boundary, whose `build`
+        carries the `abandoned` outcome and `tokens_discarded`; this stamps
+        WHEN the disconnect was observed."""
         self.abandoned_at = ts
 
     # -- the record --------------------------------------------------------
@@ -177,7 +194,8 @@ class RequestTraceBuilder:
               ttft: float | None = None, tpot: float | None = None,
               queue_wait: float | None = None,
               slo_breach: list | None = None,
-              capture: str | None = None) -> dict:
+              capture: str | None = None,
+              tokens_discarded: int | None = None) -> dict:
         if self.abandoned_at is not None:
             self.spans.append({"name": "abandoned", "ts": self.abandoned_at})
         prefill_s = round(sum(s["dur"] for s in self.spans
@@ -216,8 +234,16 @@ class RequestTraceBuilder:
                              "ticks": self.ticks,
                              "shared_with": {str(k): v for k, v in
                                              sorted(self.shared_with.items())}}
+        if self.prefix_tokens:
+            rec["prefix_cached_tokens"] = self.prefix_tokens
+            rec["prefix_shared_pages"] = self.prefix_pages
+            if self.prefix_cow:
+                rec["prefix_cow_fork"] = True
         if self.abandoned_at is not None:
             rec["abandoned"] = True
+        if tokens_discarded is not None:
+            # cancellation satellite: tokens generated that no client read
+            rec["tokens_discarded"] = tokens_discarded
         if slo_breach:
             rec["slo_breach"] = list(slo_breach)
         if capture:

@@ -176,6 +176,14 @@ class SLOStats:
         self.abandoned = 0
         self.slo_breaches = 0
         self.tokens_generated = 0
+        # prefix-cache accounting (serve/pages.py "Prefix caching"): all
+        # zero — and absent from snapshots — unless the engine records a
+        # cache verdict, so cache-off metrics lines stay byte-identical
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_cached_tokens = 0
+        self.prefix_shared_pages = 0
+        self.prefix_cow_forks = 0
         self._tenants: dict[str, _TenantStats] = {}
 
     def _tenant(self, tenant: str | None) -> "_TenantStats | None":
@@ -236,9 +244,9 @@ class SLOStats:
 
     def record_abandoned(self, tenant: str | None = None) -> None:
         """The client hung up mid-stream (frontend OSError path). The
-        request still decodes to completion — there is no cancellation
-        protocol yet — so abandoned work is INVISIBLE compute unless
-        counted: this is the honest gauge of tokens generated for nobody."""
+        engine cancels the request at the next step boundary — slot and
+        unshared pages freed, `tokens_discarded` on its trace — so this
+        counter is the rate of work the fleet started for nobody."""
         with self._lock:
             self.abandoned += 1
             ts = self._tenant(tenant)
@@ -254,6 +262,23 @@ class SLOStats:
             ts = self._tenant(tenant)
             if ts is not None:
                 ts.slo_breaches += 1
+
+    def record_prefix(self, cached_tokens: int, shared_pages: int,
+                      cow_fork: bool) -> None:
+        """One prefix-cache admission verdict (paged cache with
+        `prefix_cache` on): a hit served `cached_tokens` padded-row
+        positions from `shared_pages` shared pages (plus a copy-on-write
+        fork when the divergence landed mid-page); zero cached tokens is
+        a miss. Hit RATE — hits/(hits+misses) — is the gauge the fleet
+        alerts on."""
+        with self._lock:
+            if cached_tokens > 0:
+                self.prefix_hits += 1
+            else:
+                self.prefix_misses += 1
+            self.prefix_cached_tokens += cached_tokens
+            self.prefix_shared_pages += shared_pages
+            self.prefix_cow_forks += int(cow_fork)
 
     def record_page_refused(self) -> None:
         """Rejected because the free-page pool could not cover the
@@ -278,6 +303,15 @@ class SLOStats:
             out.update(percentiles_ms(list(self.ttft), "ttft"))
             out.update(percentiles_ms(list(self.tpot), "tpot"))
             out.update(percentiles_ms(list(self.queue_wait), "queue_wait"))
+            if self.prefix_hits or self.prefix_misses:
+                out["prefix_hits"] = self.prefix_hits
+                out["prefix_misses"] = self.prefix_misses
+                out["prefix_hit_rate"] = round(
+                    self.prefix_hits
+                    / (self.prefix_hits + self.prefix_misses), 4)
+                out["prefix_cached_tokens"] = self.prefix_cached_tokens
+                out["prefix_shared_pages"] = self.prefix_shared_pages
+                out["prefix_cow_forks"] = self.prefix_cow_forks
             if self._tenants:
                 out["tenants"] = {name: ts.snapshot() for name, ts in
                                   sorted(self._tenants.items())}

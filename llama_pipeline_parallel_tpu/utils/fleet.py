@@ -237,7 +237,8 @@ def latest_verified_step(checkpoint_root: str) -> int | None:
 
 ALERT_KEYS = {"heartbeat_stale_s", "goodput_floor", "step_time_p95_s",
               "ttft_p95_ms", "queue_wait_p95_ms", "tenant_ttft_p95_ms",
-              "checkpoint_lag_steps", "nonfinite_steps", "oom_recent"}
+              "prefix_hit_rate_floor", "checkpoint_lag_steps",
+              "nonfinite_steps", "oom_recent"}
 # config key -> the rule name edges/status use (the `_s`/`_ms` unit
 # suffixes are config spelling, not alert identity)
 _RULE_NAMES = {"heartbeat_stale_s": "heartbeat_stale",
@@ -246,6 +247,7 @@ _RULE_NAMES = {"heartbeat_stale_s": "heartbeat_stale",
                "ttft_p95_ms": "ttft_p95",
                "queue_wait_p95_ms": "queue_wait_p95",
                "tenant_ttft_p95_ms": "tenant_ttft_p95",
+               "prefix_hit_rate_floor": "prefix_hit_rate",
                "checkpoint_lag_steps": "checkpoint_lag",
                "nonfinite_steps": "nonfinite_steps",
                "oom_recent": "oom_recent"}
@@ -283,6 +285,13 @@ class AlertRules:
       `tenant_ttft_p95:<tenant>` — independent fire/resolve edges and
       damping state per tenant, the scaffolding per-tenant SLO classes
       (ROADMAP item 2) will actuate on.
+    - prefix_hit_rate_floor: a prefix-caching serve replica's cumulative
+      hit rate (prefix_hits / (prefix_hits + prefix_misses), the
+      `prefix_hit_rate` metrics field) BELOW this fires — a cache that
+      stopped hitting on a shared-prefix workload means the eviction
+      churn or the traffic mix changed under the replica. Only evaluated
+      when the replica reports the field (prefix cache on, some traffic
+      admitted or refused).
     - checkpoint_lag_steps: serve replica's loaded checkpoint step more
       than this many steps behind the trainer's latest verified one.
     - nonfinite_steps: more than this many nonfinite training steps
@@ -301,6 +310,7 @@ class AlertRules:
     ttft_p95_ms: float | None = None
     queue_wait_p95_ms: float | None = None
     tenant_ttft_p95_ms: float | None = None
+    prefix_hit_rate_floor: float | None = None
     checkpoint_lag_steps: int | None = None
     nonfinite_steps: int | None = None
     oom_recent: int | None = None
@@ -405,6 +415,13 @@ class AlertRules:
                 tt = _num(snap.get("ttft_p95_ms"))
                 rule(f"tenant_ttft_p95:{name}", tt, self.tenant_ttft_p95_ms,
                      tt is not None and tt > self.tenant_ttft_p95_ms)
+        # floor rule, like goodput_floor: fires when the value drops BELOW
+        # the threshold; absent field (cache off / no traffic yet) is not
+        # evaluated — absence of data must not fabricate a firing
+        phr = _num(member.get("prefix_hit_rate"))
+        rule("prefix_hit_rate", phr, self.prefix_hit_rate_floor,
+             phr is not None and self.prefix_hit_rate_floor is not None
+             and phr < self.prefix_hit_rate_floor)
         lag = _num(member.get("checkpoint_lag"))
         rule("checkpoint_lag", lag, self.checkpoint_lag_steps,
              lag is not None and self.checkpoint_lag_steps is not None
@@ -438,7 +455,11 @@ _SERVE_FIELDS = ("requests_completed", "requests_rejected", "requests_failed",
                  "pages_reserved", "pages_total", "reserved_unbacked",
                  "page_fragmentation", "reserved_gap_bytes",
                  "page_allocations", "prefilling", "prefill_chunks_total",
-                 "prefill_tokens_total", "requests_abandoned", "tenants")
+                 "prefill_tokens_total", "requests_abandoned", "tenants",
+                 "prefix_cache", "prefix_hits", "prefix_misses",
+                 "prefix_hit_rate", "prefix_cached_tokens",
+                 "prefix_shared_pages", "prefix_cow_forks", "pages_cached",
+                 "prefix_evictions")
 _STEP_TIME_WINDOW = 64
 
 

@@ -388,6 +388,10 @@ def test_page_exhaustion_refusal_and_retry_after_release(setup):
         assert len(h.result(timeout=1)) == 8
 
 
+@pytest.mark.slow  # funds the Prefix cache tier-1 rows: the unit-level
+# refusal/retry contract stays fast above, and tests/test_prefix_cache.py
+# re-pins the 429 math under page sharing — this HTTP re-run of the same
+# mapping (server thread + full drain) stays pinned in the round gate.
 def test_page_exhaustion_maps_to_http_429_with_retry_after(setup):
     """The frontend maps ServePagesExhausted to HTTP 429 + Retry-After;
     the client's retry succeeds once the pool drains."""
@@ -517,6 +521,10 @@ def test_int8_pages_tolerance_gate_vs_dequantized_reference(setup):
         f"int8 greedy tokens drifted past the gate: {q_toks} vs {fp_toks}"
 
 
+@pytest.mark.slow  # funds the Prefix cache tier-1 rows: first-token
+# equality and greedy agreement are already clauses of the tolerance gate
+# above — this two-full-engine e2e re-run of the same contract stays
+# pinned in the round gate.
 def test_int8_engine_first_token_matches_fp(setup):
     """Prefill logits are computed unquantized, so the FIRST token of an
     int8-paged request always equals the fp path's; the rest of the stream

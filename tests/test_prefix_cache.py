@@ -1,0 +1,568 @@
+"""Prefix caching over the paged KV pool (serve/pages.py block-hash chains
++ copy-on-write forks, the warm-admission span path in serve/engine.py and
+models/llama/decode.py — docs/SERVING.md "Prefix caching").
+
+The acceptance contracts live here:
+- a cache-hit request's tokens are BIT-EQUAL (fp32 and bf16) to the same
+  request served cold on a cache-off engine AND to an independent
+  `generate()` call — full-row re-serve, mid-page divergence (CoW fork),
+  and page-boundary divergence (no fork) all land on the same stream;
+- sharing is cache-aware admission: at a fixed pool the shared-prefix
+  workload admits >= 2x what the cache-off reservation math admits, the
+  admissions are REAL (every one reaches a slot), and the refusal is
+  still ServePagesExhausted with a positive Retry-After;
+- refcount-0 cached pages evict (LRU, whole-subtree cascade) BEFORE the
+  pool refuses, and an evicted-then-refilled prompt reproduces its
+  original tokens exactly;
+- nothing leaks: after draining, non-cached pages are back on the free
+  list, every cached page sits at refcount zero on the idle list, and a
+  cancelled (abandoned) request frees its slot + unshared pages at the
+  next tick while shared pages just drop a refcount;
+- cache OFF is the exact PR-13 engine: no prefix keys in the snapshot,
+  identical exhaustion math; `prefix_cache` on the dense cache is a
+  config error;
+- int8 pages keep the tolerance-gated contract (greedy warm stream
+  matches the greedy cold int8 stream token-for-token on this grid);
+- the telemetry shows up end-to-end: engine snapshot counters, the
+  `prefix_cache_hit` span + record fields in request_trace.jsonl, and
+  the serving_report / request_report render lines.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import request_report
+import serve_traffic as traffic
+import serving_report
+from llama_pipeline_parallel_tpu.models.llama import model as llama
+from llama_pipeline_parallel_tpu.models.llama.config import LlamaConfig
+from llama_pipeline_parallel_tpu.models.llama.decode import (
+    GenerationConfig,
+    generate,
+)
+from llama_pipeline_parallel_tpu.serve import (
+    PagedKVCache,
+    ServeConfig,
+    ServeEngine,
+    ServePagesExhausted,
+    ServeRequest,
+)
+from llama_pipeline_parallel_tpu.serve.pages import chain_hashes, page_demand
+from llama_pipeline_parallel_tpu.serve.reqtrace import (
+    REQUEST_TRACE_NAME,
+    RequestTraceRecorder,
+)
+from llama_pipeline_parallel_tpu.utils.perf import read_jsonl
+
+BUCKET = 8
+PAGE = 4
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def make_engine(cfg, params, **kw):
+    """The standard paged test shape (test_paged_serving.py) with the
+    prefix cache ON — shared so the warm-span programs compile once."""
+    reqtrace = kw.pop("reqtrace", None)
+    defaults = dict(max_slots=2, max_len=BUCKET + 8, prompt_buckets=(BUCKET,),
+                    max_queue=8, metrics_every=1, decode_span_every=1,
+                    kv_cache="paged", page_size=PAGE, num_pages=16,
+                    prefix_cache=True)
+    defaults.update(kw)
+    return ServeEngine(params, cfg, ServeConfig(**defaults),
+                       reqtrace=reqtrace)
+
+
+def reference_tokens(params, cfg, prompt, gen, seed, bucket=BUCKET):
+    pad = bucket - len(prompt)
+    ids = np.concatenate([np.zeros(pad, np.int32),
+                          np.asarray(prompt, np.int32)])[None]
+    mask = np.asarray([[0] * pad + [1] * len(prompt)], np.int32)
+    out = generate(params, jnp.asarray(ids), jnp.asarray(mask), cfg, gen,
+                   rng=jax.random.PRNGKey(seed))
+    return np.asarray(out["tokens"])[0].tolist()
+
+
+def serve_one(engine, prompt, gen, seed):
+    h = engine.submit(ServeRequest(input_ids=list(prompt), gen=gen,
+                                   seed=seed))
+    engine.drain(timeout_s=120)
+    return h.result(timeout=1), h
+
+
+def assert_no_leaks(engine):
+    """The drained-pool invariant: every non-cached page is free, every
+    cached page is idle at refcount zero, nothing is reserved."""
+    s = engine.slots
+    assert s.pages_reserved == 0
+    assert s._held == 0 and not s._ref
+    assert set(s._idle) == set(s._page_node)
+    assert s.pages_free == s.num_pages - s.pages_cached
+
+
+# -- block-hash chains (host-side, no engine) ---------------------------------
+
+
+def test_chain_hashes_depend_on_content_chain_and_mask():
+    ids = np.arange(8, dtype=np.int32) + 3
+    mask = np.ones(8, np.int32)
+    base = chain_hashes(ids, mask, PAGE)
+    assert len(base) == 2
+    assert chain_hashes(ids.copy(), mask.copy(), PAGE) == base
+
+    # a late-block edit leaves earlier hashes intact (prefix reuse)...
+    late = ids.copy()
+    late[6] += 1
+    h = chain_hashes(late, mask, PAGE)
+    assert h[0] == base[0] and h[1] != base[1]
+
+    # ...an early edit poisons the whole chain (KV at j depends on [0, j])
+    early = ids.copy()
+    early[2] += 1
+    h = chain_hashes(early, mask, PAGE)
+    assert h[0] != base[0] and h[1] != base[1]
+
+    # pad layout participates: same ids, different mask must NOT share
+    shifted = mask.copy()
+    shifted[0] = 0
+    h = chain_hashes(ids, shifted, PAGE)
+    assert h[0] != base[0] and h[1] != base[1]
+
+
+def _register_chain(cache, ids, mask, demand, rid="seed"):
+    """Drive one prompt through the miss -> prefill -> register -> release
+    lifecycle so its pages sit cached at refcount zero."""
+    m = cache.match_and_reserve(rid, ids, mask, demand)
+    assert m is not None and m.tokens == 0 and m.pages == []
+    slot = cache.acquire(rid, m.new_demand, match=m)
+    cache.ensure_capacity(slot, len(ids))
+    assert cache.register_prefix(slot, m.hashes, ids, mask) == \
+        len(ids) // cache.page_size
+    cache.release(slot)
+    return m.hashes
+
+
+def test_match_geometry_full_midpage_and_boundary(setup):
+    cfg, _ = setup
+    cache = PagedKVCache(cfg, max_slots=2, max_len=16, page_size=PAGE,
+                         num_pages=8, prefix_cache=True)
+    ids = np.arange(8, dtype=np.int32) + 3
+    mask = np.ones(8, np.int32)
+    hashes = _register_chain(cache, ids, mask, page_demand(8, 8, PAGE))
+    assert cache.pages_cached == 2 and cache._held == 0
+    assert cache.pages_free == 6
+    p0 = cache._index[hashes[0]].page
+    p1 = cache._index[hashes[1]].page
+
+    # full-row match: one position must recompute for the first-token
+    # sample, so the verdict caps at bucket-1 and forks the last page
+    m = cache.match_and_reserve("full", ids, mask, 4)
+    assert (m.tokens, m.pages, m.fork_src, m.new_demand) == (7, [p0], p1, 3)
+    cache.cancel_match(m)
+
+    # page-boundary divergence: whole pages share, nothing forks
+    bnd = ids.copy()
+    bnd[4] += 1
+    m = cache.match_and_reserve("bnd", bnd, mask, 4)
+    assert (m.tokens, m.pages, m.fork_src, m.new_demand) == (4, [p0], None, 3)
+    cache.cancel_match(m)
+
+    # mid-page divergence: the longest common block prefix forks its page
+    mid = ids.copy()
+    mid[6] += 1
+    m = cache.match_and_reserve("mid", mid, mask, 4)
+    assert (m.tokens, m.pages, m.fork_src, m.new_demand) == (6, [p0], p1, 3)
+    cache.cancel_match(m)
+
+    # every pin undone: cached pages idle again, nothing reserved or held
+    assert cache._held == 0 and cache.pages_reserved == 0
+    assert len(cache._idle) == 2
+
+
+def test_refcount_zero_pages_evict_before_refusal(setup):
+    cfg, _ = setup
+    cache = PagedKVCache(cfg, max_slots=2, max_len=16, page_size=PAGE,
+                         num_pages=4, prefix_cache=True)
+    ids = np.arange(8, dtype=np.int32) + 3
+    mask = np.ones(8, np.int32)
+    _register_chain(cache, ids, mask, page_demand(8, 1, PAGE))
+    assert (cache.pages_cached, cache.pages_free) == (2, 2)
+
+    # idle cached pages do NOT count against admission: the whole pool is
+    # still reservable even though only two pages sit on the free list
+    assert cache.reserve(4)
+    slot = cache.acquire("r2", 4)
+    cache.ensure_capacity(slot, 16)    # needs 4 pages: evicts the chain
+    assert cache.prefix_evictions == 2 and cache.pages_cached == 0
+    cache.release(slot)
+    assert cache.pages_free == 4
+
+
+# -- traffic-shape purity ------------------------------------------------------
+
+
+def test_prefix_mix_draws_do_not_perturb_the_trace():
+    kw = dict(prompt_mix=traffic.parse_mix("8:0.5,16:0.5"),
+              output_mix=traffic.parse_mix("4:1.0"))
+    base = traffic.poisson_trace(3, 8.0, 20, **kw)
+    mixed = traffic.poisson_trace(
+        3, 8.0, 20, prefix_mix=traffic.parse_prefix_mix("sys16:0.5,cold:0.5"),
+        **kw)
+    # prefix draws come AFTER the arrival/length/seed streams: the trace
+    # is identical in every pre-existing dimension
+    key = lambda r: (r.arrival_s, r.prompt_len, r.max_new_tokens, r.seed,
+                     r.tenant)
+    assert [key(r) for r in base] == [key(r) for r in mixed]
+    assert all(r.prefix is None for r in base)
+    assert {(r.prefix, r.prefix_len) for r in mixed} <= \
+        {("sys16", 16), ("cold", 0)}
+    assert any(r.prefix == "sys16" for r in mixed)
+    # the class prefix is a pure function of the class name
+    assert traffic.prefix_ids("sys16", 16, 256) == \
+        traffic.prefix_ids("sys16", 16, 256)
+    assert traffic.prefix_ids("sys16", 16, 256) != \
+        traffic.prefix_ids("other16", 16, 256)
+
+
+# -- the parity gate (fp32 grid, bf16, int8) -----------------------------------
+
+
+def test_warm_hits_bit_equal_cold_engine_and_generate(setup):
+    cfg, params = setup
+    gen = GenerationConfig(max_new_tokens=6, temperature=0.8, top_k=5)
+    rng = np.random.RandomState(11)
+    base = rng.randint(3, cfg.vocab_size, size=BUCKET).tolist()
+    mid = list(base)
+    mid[6] = 3 + (mid[6] - 2) % (cfg.vocab_size - 3)      # diverge mid-page
+    bnd = list(base)
+    bnd[4] = 3 + (bnd[4] - 2) % (cfg.vocab_size - 3)      # diverge at page 1
+    plan = [(base, 1), (base, 2), (mid, 3), (bnd, 4)]
+
+    warm = make_engine(cfg, params)
+    cold = make_engine(cfg, params, prefix_cache=False)
+    got = {}
+    for prompt, seed in plan:
+        tokens, h = serve_one(warm, prompt, gen, seed)
+        got[seed] = (tokens, h.prefix_cached_tokens)
+    # two CONCURRENT hits map the same physical pages read-only
+    h5 = warm.submit(ServeRequest(input_ids=list(base), gen=gen, seed=5))
+    h6 = warm.submit(ServeRequest(input_ids=list(base), gen=gen, seed=6))
+    warm.drain(timeout_s=120)
+    got[5] = (h5.result(timeout=1), h5.prefix_cached_tokens)
+    got[6] = (h6.result(timeout=1), h6.prefix_cached_tokens)
+
+    # the hit geometry: miss, full-row (bucket-1), mid-page, page-boundary
+    assert [got[s][1] for s in (1, 2, 3, 4, 5, 6)] == [0, 7, 6, 4, 7, 7]
+    for prompt, seed in plan + [(base, 5), (base, 6)]:
+        cold_tokens, ch = serve_one(cold, prompt, gen, seed)
+        assert ch.prefix_cached_tokens == 0
+        ref = reference_tokens(params, cfg, prompt, gen, seed)
+        assert got[seed][0] == cold_tokens == ref, f"seed {seed} diverged"
+
+    snap = warm.metrics_snapshot()
+    assert snap["prefix_cache"] == 1
+    assert (snap["prefix_hits"], snap["prefix_misses"]) == (5, 1)
+    assert snap["prefix_hit_rate"] == round(5 / 6, 4)
+    assert snap["prefix_cached_tokens"] == 7 + 6 + 4 + 7 + 7
+    assert snap["prefix_cow_forks"] == 4          # full x3 + mid; bnd doesn't
+    assert snap["pages_cached"] == 4              # base chain + 2 tail forks
+    assert snap["prefix_evictions"] == 0
+    assert_no_leaks(warm)
+    off = cold.metrics_snapshot()
+    assert "prefix_cache" not in off and "prefix_hits" not in off
+    warm.shutdown()
+    cold.shutdown()
+
+
+def test_warm_hit_bit_equal_bf16(setup):
+    cfg_b = LlamaConfig.tiny(dtype=jnp.bfloat16)
+    params_b = llama.init_params(jax.random.PRNGKey(0), cfg_b)
+    gen = GenerationConfig(max_new_tokens=4)
+    prompt = list(range(5, 5 + BUCKET))
+    warm = make_engine(cfg_b, params_b)
+    cold_tokens, _ = serve_one(make_engine(cfg_b, params_b,
+                                           prefix_cache=False),
+                               prompt, gen, 7)
+    first, _ = serve_one(warm, prompt, gen, 7)
+    hit, h = serve_one(warm, prompt, gen, 7)
+    assert h.prefix_cached_tokens == BUCKET - 1
+    ref = reference_tokens(params_b, cfg_b, prompt, gen, 7)
+    assert first == hit == cold_tokens == ref
+    assert_no_leaks(warm)
+    warm.shutdown()
+
+
+def test_int8_warm_greedy_matches_cold_int8(setup):
+    cfg, params = setup
+    gen = GenerationConfig(max_new_tokens=5)                # greedy
+    prompt = [9, 4, 11, 6, 13, 8, 15, 10]
+    cold_tokens, _ = serve_one(
+        make_engine(cfg, params, kv_quant="int8", prefix_cache=False),
+        prompt, gen, 0)
+    warm = make_engine(cfg, params, kv_quant="int8")
+    first, _ = serve_one(warm, prompt, gen, 0)
+    assert first == cold_tokens                   # cold path is unchanged
+    hit, h = serve_one(warm, prompt, gen, 0)
+    assert h.prefix_cached_tokens == BUCKET - 1
+    # the PR-13 spirit of the int8 contract, token-level: the warm stream
+    # (span recompute + decode over dequantized shared pages) greedily
+    # agrees with the cold int8 stream
+    assert hit == cold_tokens
+    assert_no_leaks(warm)
+    warm.shutdown()
+
+
+# -- eviction under pressure, then refill --------------------------------------
+
+
+def test_eviction_then_refill_reproduces_tokens(setup):
+    cfg, params = setup
+    gen = GenerationConfig(max_new_tokens=8)      # demand: the full 4 pages
+    engine = make_engine(cfg, params, max_slots=1, num_pages=8)
+    prompts = {}
+    rng = np.random.RandomState(23)
+    for name in "ABCD":
+        prompts[name] = rng.randint(3, cfg.vocab_size, size=BUCKET).tolist()
+
+    tokens_a, _ = serve_one(engine, prompts["A"], gen, 1)
+    assert tokens_a == reference_tokens(params, cfg, prompts["A"], gen, 1)
+    for name in "BC":
+        serve_one(engine, prompts[name], gen, 1)
+    assert engine.slots.pages_cached == 6 and engine.slots.prefix_evictions == 0
+
+    # D's allocation outruns the free list: the LRU chain (A, released
+    # first) evicts as a subtree instead of the pool refusing
+    serve_one(engine, prompts["D"], gen, 1)
+    assert engine.slots.prefix_evictions == 2
+
+    # refill: A is a miss again, but its tokens reproduce exactly...
+    again, h = serve_one(engine, prompts["A"], gen, 1)
+    assert h.prefix_cached_tokens == 0
+    assert again == tokens_a
+    # ...and the refilled chain serves the next request as a hit
+    third, h = serve_one(engine, prompts["A"], gen, 1)
+    assert h.prefix_cached_tokens == BUCKET - 1
+    assert third == tokens_a
+    assert_no_leaks(engine)
+    engine.shutdown()
+
+
+# -- cache-aware admission at a fixed pool -------------------------------------
+
+
+def test_sharing_doubles_admissions_at_fixed_pool(setup):
+    cfg, params = setup
+    bucket, pool = 16, 20
+    gen = GenerationConfig(max_new_tokens=4)
+    assert page_demand(bucket, 4, PAGE) == 5      # worst-case, cache off
+    shared = list(range(30, 30 + 12))             # three full shared pages
+    prompts = [shared + [3 + i, 7, 8, 9] for i in range(10)]
+
+    def fixed_pool_engine(**kw):
+        return make_engine(cfg, params, max_slots=12, max_len=bucket + 4,
+                           prompt_buckets=(bucket,), max_queue=64,
+                           num_pages=pool, **kw)
+
+    def admit_until_refused(engine):
+        admitted = 0
+        for prompt in prompts:
+            try:
+                engine.submit(ServeRequest(input_ids=list(prompt), gen=gen,
+                                           seed=admitted))
+            except ServePagesExhausted as exc:
+                assert exc.retry_after_s > 0
+                return admitted
+            admitted += 1
+        raise AssertionError("pool never refused")
+
+    cold = fixed_pool_engine(prefix_cache=False)
+    cold_admitted = admit_until_refused(cold)
+    assert cold_admitted == pool // 5             # the PR-13 reservation math
+    cold.shutdown()
+
+    warm = fixed_pool_engine()
+    serve_one(warm, shared + [200, 7, 8, 9], gen, 99)     # prime the chain
+    warm_admitted = admit_until_refused(warm)
+    assert warm_admitted >= 2 * cold_admitted
+    assert warm_admitted == 8                     # 3 held + 8 * 2 <= 20 < +2
+
+    # the admissions are REAL: every one reaches a slot and prefills
+    for _ in range(4):
+        warm._advance_prefill()
+    assert warm.slots.active_count == warm_admitted
+    assert warm.queue_depth() == 0
+
+    # refcount-aware gauges: a page shared by 8 slots is counted ONCE —
+    # the logical mapping count exceeds the physical pages_used
+    table = warm.slots.page_table
+    live = table[table != warm.slots.garbage_page]
+    assert len(live) == warm_admitted * 4
+    assert warm.slots.pages_used == len(np.unique(live)) + 1  # + idle tail
+    assert warm.slots.pages_used < len(live)
+    assert warm.slots.reserved_unbacked >= 0
+    assert "pages_cached" in warm.slots.fragmentation_gauges()
+    warm.shutdown()
+
+
+def test_cache_off_is_the_baseline_engine(setup):
+    cfg, params = setup
+    with pytest.raises(ValueError, match="prefix_cache"):
+        ServeConfig(kv_cache="dense", prefix_cache=True)
+    engine = make_engine(cfg, params, prefix_cache=False, max_slots=8)
+    gen = GenerationConfig(max_new_tokens=8)
+    for i in range(4):                            # 16 pages / demand 4
+        engine.submit(ServeRequest(input_ids=[3 + i] * BUCKET, gen=gen,
+                                   seed=i))
+    with pytest.raises(ServePagesExhausted):
+        engine.submit(ServeRequest(input_ids=[50] * BUCKET, gen=gen, seed=9))
+    s = engine.slots
+    assert s._held == 0 and s.pages_cached == 0 and s.pages_reserved == 16
+    assert "pages_cached" not in s.fragmentation_gauges()
+    engine.shutdown()
+
+
+# -- cancellation frees slots, pages, and queued pins --------------------------
+
+
+def test_abandoned_requests_release_pages_at_next_tick(setup):
+    cfg, params = setup
+    gen = GenerationConfig(max_new_tokens=6)
+    engine = make_engine(cfg, params, max_slots=1)
+    prompt = [7, 12, 9, 14, 11, 16, 13, 18]
+    serve_one(engine, prompt, gen, 1)             # prime: 2 cached pages
+
+    h1 = engine.submit(ServeRequest(input_ids=list(prompt), gen=gen, seed=2))
+    engine.step()                                 # h1 admits + streams
+    engine.step()
+    h2 = engine.submit(ServeRequest(input_ids=list(prompt), gen=gen, seed=3))
+    assert engine.queue_depth() == 1              # queued with its pins live
+    assert 0 < len(h1.tokens_out) < 6
+
+    engine.note_abandoned(h1.request)
+    engine.note_abandoned(h2.request)
+    engine.step()                                 # cancels at the boundary
+    # the decoding slot freed (unshared pages released, shared refcounts
+    # dropped); the queued entry's pins + reservation unwound
+    assert engine.slots.free_count == 1
+    assert engine.queue_depth() == 0
+    assert_no_leaks(engine)
+    # both handles complete with what they had — no error, partial stream
+    assert h1.result(timeout=1) == h1.tokens_out and len(h1.tokens_out) < 6
+    assert h2.result(timeout=1) == []
+    assert engine.metrics_snapshot()["requests_abandoned"] == 2
+    engine.shutdown()
+
+
+# -- the measured win ----------------------------------------------------------
+
+
+def test_shared_mix_trace_hits_every_hot_request(setup):
+    cfg_big = LlamaConfig.tiny(max_position_embeddings=256)
+    _, params = setup
+    pre, tail, bucket = 112, 16, 128
+    shared = traffic.prefix_ids(f"sys{pre}", pre, cfg_big.vocab_size)
+    trace = traffic.poisson_trace(
+        5, 100.0, 12, prompt_mix=traffic.parse_mix(f"{tail}:1.0"),
+        output_mix=traffic.parse_mix("4:1.0"),
+        prefix_mix=traffic.parse_prefix_mix(f"sys{pre}:0.9,cold:0.1"))
+    gen = GenerationConfig(max_new_tokens=4)
+
+    engine = make_engine(cfg_big, params, max_slots=4, max_len=144,
+                         prompt_buckets=(tail, bucket), max_queue=32,
+                         num_pages=16 * 144 // PAGE)
+    serve_one(engine, shared + [3] * tail, gen, 0)        # prime the chain
+    summary = traffic.run_trace(engine, trace, result_timeout_s=120)
+    engine.shutdown()
+
+    assert summary["submitted"] == 12 and summary["requests_failed"] == 0
+    classes = summary["prefix_classes"]
+    hot = classes[f"sys{pre}"]
+    assert hot["hit_rate"] == 1.0
+    # every hot-class request skipped AT LEAST the shared prefix's prefill
+    assert hot["cached_tokens"] >= pre * hot["hits"]
+    assert hot["submitted"] + classes.get("cold", {}).get("submitted", 0) \
+        == 12
+
+
+def test_cache_hit_ttft_beats_cold_prefill(setup):
+    """The measured CPU win: a closed-loop (one request in flight, compiles
+    paid off the clock) TTFT median over a 496-token shared prefix — the
+    hit prefills a 16-token span instead of the 512-token bucket."""
+    cfg_big = LlamaConfig.tiny(max_position_embeddings=768)
+    _, params = setup
+    pre, tail, bucket = 496, 16, 512
+    shared = traffic.prefix_ids(f"sys{pre}", pre, cfg_big.vocab_size)
+    gen = GenerationConfig(max_new_tokens=4)
+
+    def ttft_median(cache_on):
+        engine = make_engine(cfg_big, params, max_len=bucket + 16,
+                             prompt_buckets=(bucket,), max_queue=16,
+                             num_pages=8 * (bucket + 16) // PAGE,
+                             prefix_cache=cache_on)
+
+        def serve_timed(prompt):
+            t0 = time.perf_counter()
+            h = engine.submit(ServeRequest(input_ids=list(prompt), gen=gen,
+                                           seed=0))
+            while not h.tokens_out:
+                engine.step()
+            ttft = time.perf_counter() - t0
+            engine.drain(timeout_s=300)
+            return ttft, h.prefix_cached_tokens
+
+        serve_timed(shared + [3] * tail)    # compile prefill / prime chain
+        serve_timed(shared + [4] * tail)    # compile the warm span path
+        timed = [serve_timed(shared + [5 + i] * tail) for i in range(5)]
+        engine.shutdown()
+        assert [c for _, c in timed] == [pre if cache_on else 0] * 5
+        return float(np.median([t for t, _ in timed]))
+
+    hot, cold = ttft_median(True), ttft_median(False)
+    print(f"closed-loop TTFT median, {pre}-token shared prefix at bucket "
+          f"{bucket}: hit {1000 * hot:.2f} ms vs cold {1000 * cold:.2f} ms")
+    assert hot < cold
+
+
+# -- telemetry renders end-to-end ----------------------------------------------
+
+
+def test_reports_render_prefix_cache_lines(setup, tmp_path, capsys):
+    cfg, params = setup
+    rec = RequestTraceRecorder(str(tmp_path))
+    engine = make_engine(cfg, params, reqtrace=rec)
+    gen = GenerationConfig(max_new_tokens=4)
+    prompt = [21, 8, 23, 10, 25, 12, 27, 14]
+    serve_one(engine, prompt, gen, 1)
+    serve_one(engine, prompt, gen, 2)             # the hit
+    with open(tmp_path / "metrics.jsonl", "w") as f:
+        f.write(json.dumps(engine.metrics_snapshot()) + "\n")
+    rec.close()
+    engine.shutdown()
+
+    rows = read_jsonl(str(tmp_path / REQUEST_TRACE_NAME))
+    hit = [r for r in rows if r.get("prefix_cached_tokens")]
+    assert len(hit) == 1
+    assert hit[0]["prefix_cached_tokens"] == BUCKET - 1
+    assert hit[0]["prefix_shared_pages"] == 1
+    assert hit[0]["prefix_cow_fork"] is True
+    assert any(s.get("name") == "prefix_cache_hit"
+               for s in hit[0]["spans"])
+    bd = request_report.ttft_breakdown(hit[0])
+    assert bd["prefix_cached_tokens"] == BUCKET - 1
+
+    assert request_report.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "prefix cache: 1 hit(s), 7 cached tokens" in out
+    assert serving_report.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "prefix:" in out and "prefix_hit_rate=0.5" in out

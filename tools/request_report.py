@@ -78,14 +78,23 @@ def ttft_breakdown(rec: dict) -> dict | None:
     prefill = _num(rec.get("prefill_s")) or 0.0
     interleave = max(ttft - queue - prefill, 0.0)
     decode = max((_num(rec.get("wall_s")) or ttft) - ttft, 0.0)
-    return {"ttft_s": ttft,
-            "queue_s": round(queue, 6),
-            "prefill_s": round(prefill, 6),
-            "interleave_s": round(interleave, 6),
-            "decode_s": round(decode, 6),
-            "queue_pct": round(100 * queue / ttft, 1),
-            "prefill_pct": round(100 * prefill / ttft, 1),
-            "interleave_pct": round(100 * interleave / ttft, 1)}
+    out = {"ttft_s": ttft,
+           "queue_s": round(queue, 6),
+           "prefill_s": round(prefill, 6),
+           "interleave_s": round(interleave, 6),
+           "decode_s": round(decode, 6),
+           "queue_pct": round(100 * queue / ttft, 1),
+           "prefill_pct": round(100 * prefill / ttft, 1),
+           "interleave_pct": round(100 * interleave / ttft, 1)}
+    cached = rec.get("prefix_cached_tokens")
+    if isinstance(cached, int) and cached > 0:
+        # prefix_cache_hit component: tokens the prefix cache served from
+        # shared pages — prefill work this request never paid (the span of
+        # the same name in the waterfall carries pages/cow detail)
+        out["prefix_cached_tokens"] = cached
+        out["prefix_shared_pages"] = int(rec.get("prefix_shared_pages") or 0)
+        out["prefix_cow_fork"] = bool(rec.get("prefix_cow_fork"))
+    return out
 
 
 def tail_attribution(records: list[dict], quantile: float = 99.0) -> dict:
@@ -154,6 +163,11 @@ def exemplar_waterfall(rec: dict) -> list[str]:
             f"{bd['queue_pct']}% queue + {bd['prefill_pct']}% own prefill "
             f"+ {bd['interleave_pct']}% prefill-behind-chunked-neighbor; "
             f"decode {1000 * bd['decode_s']:.1f} ms")
+        if bd.get("prefix_cached_tokens"):
+            lines.append(
+                f"  prefix cache hit: {bd['prefix_cached_tokens']} tokens "
+                f"from {bd['prefix_shared_pages']} shared page(s)"
+                + (", CoW fork" if bd.get("prefix_cow_fork") else ""))
     for span in rec.get("spans") or []:
         if not isinstance(span, dict):
             continue
@@ -165,7 +179,7 @@ def exemplar_waterfall(rec: dict) -> list[str]:
         dur_s = f" for {1000 * dur:7.1f} ms" if dur is not None else ""
         extras = " ".join(f"{k}={span[k]}" for k in
                           ("slot", "bucket", "verdict", "offset", "tokens",
-                           "pages") if k in span)
+                           "pages", "cow") if k in span)
         lines.append(f"    {off}{dur_s}  {name:<14} {extras}".rstrip())
     decode = rec.get("decode")
     if isinstance(decode, dict):
@@ -191,7 +205,18 @@ def build_report(output_dir: str) -> dict:
     timed = [(r, t) for r in completed
              if (t := _num(r.get("ttft_s"))) is not None]
     p99_exemplar = max(timed, key=lambda it: it[1])[0] if timed else None
+    hits = [r for r in records
+            if isinstance(r.get("prefix_cached_tokens"), int)
+            and r["prefix_cached_tokens"] > 0]
+    prefix = {
+        "hits": len(hits),
+        "cached_tokens": sum(r["prefix_cached_tokens"] for r in hits),
+        "shared_pages": sum(int(r.get("prefix_shared_pages") or 0)
+                            for r in hits),
+        "cow_forks": sum(1 for r in hits if r.get("prefix_cow_fork")),
+    } if hits else None
     return {"output_dir": output_dir,
+            "prefix": prefix,
             "records": len(records),
             "completed": len(completed),
             "shed": len(shed),
@@ -227,6 +252,12 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     print(f"  {rep['records']} records: {rep['completed']} completed, "
           f"{rep['shed']} shed, {rep['abandoned']} abandoned")
+    if rep["prefix"]:
+        px = rep["prefix"]
+        print(f"  prefix cache: {px['hits']} hit(s), "
+              f"{px['cached_tokens']} cached tokens, "
+              f"{px['shared_pages']} shared page(s), "
+              f"{px['cow_forks']} CoW fork(s)")
     for metric in ("ttft", "tpot"):
         table = rep[metric]
         cells = " ".join(f"p{q}={table.get(f'{metric}_p{q}_ms', '—')}"

@@ -94,6 +94,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="per-tick prefill token budget; buckets above it "
                         "prefill in chunks interleaved with decode ticks "
                         "(paged only; 0 = whole-prompt admissions)")
+    p.add_argument("--prefix_cache", action="store_true",
+                   help="share physical KV pages between requests with "
+                        "identical prompt prefixes: cache hits skip the "
+                        "shared span's prefill and reserve only their new "
+                        "pages (paged only; docs/SERVING.md 'Prefix "
+                        "caching')")
     p.add_argument("--metrics_every", type=int, default=16,
                    help="completed requests per serving metrics line")
     p.add_argument("--health_interval", type=float, default=10.0,
@@ -161,7 +167,8 @@ def main(argv: list[str] | None = None) -> int:
         max_queue=args.max_queue, metrics_every=args.metrics_every,
         kv_cache=args.kv_cache, page_size=args.page_size,
         num_pages=args.num_pages, kv_quant=args.kv_quant,
-        prefill_chunk_tokens=args.prefill_chunk_tokens)
+        prefill_chunk_tokens=args.prefill_chunk_tokens,
+        prefix_cache=args.prefix_cache)
     writer = MetricsWriter(args.output_dir)
     tl_writer = None
     if args.timeline:
@@ -223,7 +230,8 @@ def main(argv: list[str] | None = None) -> int:
             page_size=serve_cfg.page_size,
             num_pages=serve_cfg.resolved_num_pages,
             kv_quant=serve_cfg.kv_quant,
-            prefill_chunk_tokens=serve_cfg.prefill_chunk_tokens)
+            prefill_chunk_tokens=serve_cfg.prefill_chunk_tokens,
+            prefix_cache=serve_cfg.prefix_cache)
     hb = trace.Heartbeat(
         args.output_dir, clock, interval=args.health_interval,
         static={"role": "serve", "port": port,
@@ -246,7 +254,8 @@ def main(argv: list[str] | None = None) -> int:
                    f"{serve_cfg.resolved_num_pages} x "
                    f"{serve_cfg.page_size}-token {serve_cfg.kv_quant} pages"
                    + (f", prefill chunk {serve_cfg.prefill_chunk_tokens}"
-                      if serve_cfg.prefill_chunk_tokens else ""))
+                      if serve_cfg.prefill_chunk_tokens else "")
+                   + (", prefix cache" if serve_cfg.prefix_cache else ""))
     print(f"[serve] ready on {args.host}:{port} — checkpoint step {step}, "
           f"{kv_desc}, buckets {serve_cfg.prompt_buckets}", flush=True)
     try:

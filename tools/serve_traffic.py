@@ -28,8 +28,10 @@ import argparse
 import dataclasses
 import json
 import os
+import re
 import sys
 import time
+import zlib
 
 import numpy as np
 
@@ -39,10 +41,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 @dataclasses.dataclass(frozen=True)
 class TrafficRequest:
     arrival_s: float        # offset from trace start
-    prompt_len: int
+    prompt_len: int         # tail length when a prefix class is stamped
     max_new_tokens: int
     seed: int
     tenant: str | None = None   # SLO class (per-tenant attribution)
+    # shared-prefix workload class (--prefix_mix): requests in the same
+    # class share a seeded common prefix of `prefix_len` tokens ahead of
+    # their per-seed tail — the prefix-cache hit population
+    prefix: str | None = None
+    prefix_len: int = 0
 
 
 def parse_mix(spec: str) -> tuple[tuple[int, float], ...]:
@@ -95,9 +102,48 @@ def tenant_mix_label(mix: tuple[tuple[str, float], ...]) -> str:
     return ",".join(f"{name}:{round(w, 4)}" for name, w in mix)
 
 
+def parse_prefix_mix(spec: str) -> tuple[tuple[str, int, float], ...]:
+    """`"sys512:0.9,cold:0.1"` -> (("sys512", 512, 0.9), ("cold", 0, 0.1)):
+    trailing digits in an entry name are its shared-prefix token count
+    (every request in that class gets the SAME seeded prefix of that many
+    tokens ahead of its per-request tail); a digitless name like `cold`
+    is a no-prefix class. Weights normalize like the other mixes."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, weight = part.partition(":")
+        if not name:
+            raise ValueError(f"prefix mix {spec!r} has an empty class name")
+        m = re.search(r"(\d+)$", name)
+        out.append((name, int(m.group(1)) if m else 0,
+                    float(weight) if weight else 1.0))
+    if not out:
+        raise ValueError(f"empty prefix mix {spec!r}")
+    total = sum(w for _, _, w in out)
+    if total <= 0 or any(w < 0 for _, _, w in out):
+        raise ValueError(f"prefix mix {spec!r} needs non-negative weights "
+                         f"summing > 0")
+    return tuple((name, n, w / total) for name, n, w in out)
+
+
+def prefix_mix_label(mix: tuple[tuple[str, int, float], ...]) -> str:
+    return ",".join(f"{name}:{round(w, 4)}" for name, _, w in mix)
+
+
+def prefix_ids(name: str, length: int, vocab: int,
+               low: int = 3) -> list[int]:
+    """The shared prefix token ids of class `name`: seeded by the class
+    name alone, so every request in the class — across traces and runs —
+    shares the exact same tokens (a system prompt, in effect)."""
+    rs = np.random.RandomState(zlib.crc32(name.encode()) & 0x7FFFFFFF)
+    return rs.randint(low, vocab, size=length).tolist()
+
+
 def poisson_trace(seed: int, rate_rps: float, n_requests: int,
-                  prompt_mix, output_mix,
-                  tenant_mix=None) -> list[TrafficRequest]:
+                  prompt_mix, output_mix, tenant_mix=None,
+                  prefix_mix=None) -> list[TrafficRequest]:
     """A deterministic Poisson arrival trace: exponential inter-arrival
     gaps at `rate_rps`, lengths drawn independently from the two mixes.
     Each request carries its own sampling seed (derived from the trace
@@ -106,7 +152,9 @@ def poisson_trace(seed: int, rate_rps: float, n_requests: int,
     tenant draw — all tenant draws happen AFTER the whole length/seed
     stream, so a tenantless trace is bit-identical to one generated
     before tenants existed and stamping tenants changes ONLY the tenant
-    field."""
+    field. `prefix_mix` (parse_prefix_mix) stamps a shared-prefix class
+    the same way — its draws come AFTER the tenant stream, so untenanted,
+    unprefixed traces stay tuple-identical across all three vintages."""
     if rate_rps <= 0:
         raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
     if n_requests < 1:
@@ -131,8 +179,17 @@ def poisson_trace(seed: int, rate_rps: float, n_requests: int,
                    for _ in range(n_requests)]
     else:
         tenants = [None] * n_requests
+    if prefix_mix:
+        p_names = list(range(len(prefix_mix)))
+        p_pw = [w for _, _, w in prefix_mix]
+        picks = [int(rs.choice(p_names, p=p_pw)) for _ in range(n_requests)]
+        prefixes = [(prefix_mix[j][0], prefix_mix[j][1]) for j in picks]
+    else:
+        prefixes = [(None, 0)] * n_requests
     return [TrafficRequest(arrival_s=float(arrivals[i]), prompt_len=pl,
-                           max_new_tokens=mn, seed=sd, tenant=tenants[i])
+                           max_new_tokens=mn, seed=sd, tenant=tenants[i],
+                           prefix=prefixes[i][0],
+                           prefix_len=prefixes[i][1])
             for i, (pl, mn, sd) in enumerate(draws)]
 
 
@@ -173,6 +230,12 @@ def run_trace(engine, trace_requests, time_scale: float = 1.0,
                 time.sleep(delay)
             prompt = np.random.RandomState(tr.seed).randint(
                 prompt_token_low, vocab, size=tr.prompt_len).tolist()
+            if tr.prefix_len:
+                # shared-prefix class: the class prefix ahead of the
+                # per-seed tail — same tail-length class means same total
+                # length, same bucket pad, real page sharing
+                prompt = prefix_ids(tr.prefix, tr.prefix_len, vocab,
+                                    prompt_token_low) + prompt
             req = ServeRequest(
                 input_ids=prompt,
                 gen=GenerationConfig(max_new_tokens=tr.max_new_tokens),
@@ -204,7 +267,7 @@ def run_trace(engine, trace_requests, time_scale: float = 1.0,
         "rejected_shape": rejected,
         "wall_s": round(wall, 3),
         **{k: snap[k] for k in snap
-           if k.startswith(("ttft_", "tpot_", "queue_wait_"))
+           if k.startswith(("ttft_", "tpot_", "queue_wait_", "prefix_"))
            or k in ("requests_completed", "requests_failed",
                     "tokens_generated", "prefill_chunks_total",
                     "prefill_tokens_total", "pages_total")},
@@ -212,6 +275,22 @@ def run_trace(engine, trace_requests, time_scale: float = 1.0,
     if submitted_by_tenant:
         summary["submitted_by_tenant"] = dict(
             sorted(submitted_by_tenant.items()))
+    if any(tr.prefix is not None for tr in trace_requests):
+        # per-class hit rate: what fraction of each prefix class's
+        # SUBMITTED requests were served a cached prefix (the engine-side
+        # counters aggregate across classes; this is the mix breakdown)
+        per: dict[str, dict] = {}
+        for i, h in handles:
+            name = trace_requests[i].prefix or "cold"
+            d = per.setdefault(name, {"submitted": 0, "hits": 0,
+                                      "cached_tokens": 0})
+            d["submitted"] += 1
+            if h.prefix_cached_tokens > 0:
+                d["hits"] += 1
+                d["cached_tokens"] += h.prefix_cached_tokens
+        for d in per.values():
+            d["hit_rate"] = round(d["hits"] / d["submitted"], 4)
+        summary["prefix_classes"] = dict(sorted(per.items()))
     if "tenants" in snap:
         summary["tenants"] = snap["tenants"]
     if collect_tokens:
@@ -237,6 +316,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="weighted tenant mix like 'free:0.8,paid:0.2': "
                         "stamps each generated request's tenant for "
                         "per-tenant SLO slices and request traces")
+    p.add_argument("--prefix_mix", default=None,
+                   help="shared-prefix workload mix like "
+                        "'sys512:0.9,cold:0.1': trailing digits are the "
+                        "class's common seeded prefix length in tokens "
+                        "ahead of each request's tail (digitless = no "
+                        "prefix); pair with --prefix_cache to measure "
+                        "hit-rate TTFT wins")
+    p.add_argument("--prefix_cache", action="store_true",
+                   help="enable the engine's prefix cache (paged only)")
     p.add_argument("--time_scale", type=float, default=1.0,
                    help="replay arrivals at 1/time_scale speed")
     p.add_argument("--output_dir", default=None,
@@ -273,6 +361,8 @@ def main(argv: list[str] | None = None) -> int:
     output_mix = parse_mix(args.output_mix)
     tenant_mix = (parse_tenant_mix(args.tenant_mix)
                   if args.tenant_mix else None)
+    prefix_mix = (parse_prefix_mix(args.prefix_mix)
+                  if args.prefix_mix else None)
     if args.request_trace and not args.output_dir:
         p.error("--request_trace requires --output_dir")
     params, cfg, _, step = load_module_checkpoint(args.checkpoint_dir,
@@ -291,17 +381,21 @@ def main(argv: list[str] | None = None) -> int:
         max_queue=args.max_queue, kv_cache=args.kv_cache,
         page_size=args.page_size, num_pages=args.num_pages,
         kv_quant=args.kv_quant,
-        prefill_chunk_tokens=args.prefill_chunk_tokens),
+        prefill_chunk_tokens=args.prefill_chunk_tokens,
+        prefix_cache=args.prefix_cache),
         reqtrace=reqtrace_rec)
     trace_requests = poisson_trace(args.seed, args.rate, args.requests,
                                    prompt_mix, output_mix,
-                                   tenant_mix=tenant_mix)
+                                   tenant_mix=tenant_mix,
+                                   prefix_mix=prefix_mix)
     summary = run_trace(engine, trace_requests, time_scale=args.time_scale)
     summary["mix"] = {"prompt": mix_label(prompt_mix),
                       "output": mix_label(output_mix),
                       "rate_rps": args.rate, "seed": args.seed}
     if tenant_mix is not None:
         summary["mix"]["tenant"] = tenant_mix_label(tenant_mix)
+    if prefix_mix is not None:
+        summary["mix"]["prefix"] = prefix_mix_label(prefix_mix)
     summary["checkpoint_step"] = step
     engine.shutdown()
     if reqtrace_rec is not None:

@@ -133,6 +133,18 @@ def main(argv: list[str] | None = None) -> int:
             if chunks:
                 print("  prefill:   " + " ".join(f"{k}={v}"
                                                  for k, v in chunks.items()))
+            if last.get("prefix_cache"):
+                # the prefix-cache picture (docs/SERVING.md "Prefix
+                # caching"): hit rate, tokens/pages served from shared
+                # pages, CoW forks, and the cached-page / eviction churn
+                prefix = {k: last.get(k) for k in
+                          ("prefix_hit_rate", "prefix_hits",
+                           "prefix_misses", "prefix_cached_tokens",
+                           "prefix_shared_pages", "prefix_cow_forks",
+                           "pages_cached", "prefix_evictions")
+                          if k in last}
+                print("  prefix:    " + " ".join(f"{k}={v}"
+                                                 for k, v in prefix.items()))
         tenants = last.get("tenants")
         if isinstance(tenants, dict) and tenants:
             # per-tenant attribution (serve/telemetry.py _TenantStats);
