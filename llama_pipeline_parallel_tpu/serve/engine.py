@@ -213,6 +213,12 @@ class ServeRequest:
     # absent, so every submitted request has a trace id whether or not a
     # RequestTraceRecorder is attached
     trace: TraceContext | None = None
+    # gateway-tier dispatch attribution (serve/gateway.py): when the
+    # request arrived through the routing tier this carries
+    # {"attempt": n, "replay": bool, "hedge": bool} — copied verbatim onto
+    # the request-trace record so one trace_id joins the gateway journal
+    # row to the replica-side attempt that actually served it
+    gateway: dict | None = None
 
 
 class RequestHandle:
@@ -457,7 +463,14 @@ class ServeEngine:
             mask_row[pad:] = 1
         with self._lock:
             if self._closed:  # a late submit must fail loudly, never hang
-                raise EngineShutdown("serve engine shut down")
+                # drain-time-derived Retry-After, the degraded-429 rule
+                # applied to shutdown: a relaunched replica (or a sibling
+                # behind the gateway) is up well within the hint, so the
+                # 503 tells clients WHEN to come back instead of inviting
+                # a hot retry against a dying process
+                exc = EngineShutdown("serve engine shut down")
+                exc.retry_after_s = self._retry_after(request)
+                raise exc
             if self._degraded is not None:
                 # shed, don't queue: this process is draining/mid-resize;
                 # the honest hint covers the time to finish what it WILL

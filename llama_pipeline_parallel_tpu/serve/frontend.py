@@ -31,7 +31,10 @@ freed, shared prefix pages drop a refcount.
 Backpressure maps to status codes: ServeOverloaded -> 429 with a
 Retry-After header (wait queue full, or — its ServePagesExhausted
 subclass — the paged cache's free-page pool cannot cover the request's
-worst-case demand), RequestRejected -> 400 (shape can never be served).
+worst-case demand), RequestRejected -> 400 (shape can never be served),
+EngineShutdown -> 503 with the same drain-time-derived Retry-After —
+clients and the gateway tier back off honestly instead of hot-retrying
+a draining replica.
 The engine loop runs elsewhere (tools/serve.py main thread or ServeLoop);
 handler threads only block on their request's handle.
 """
@@ -74,9 +77,31 @@ def request_from_json(body: dict,
     if tenant is not None and not isinstance(tenant, str):
         raise ValueError("tenant must be a string when present")
     gen_kw = {k: body[k] for k in GEN_KEYS if body.get(k) is not None}
+    kwargs: dict = {}
+    # gateway pass-throughs (serve/gateway.py): the routing tier supplies
+    # its journalled id as an idempotency key — a replayed request lands
+    # on a fresh replica under the SAME id, so the WAL, the replica trace
+    # and the healthz counters all name one request — plus the dispatch
+    # attribution the trace record carries
+    rid = body.get("request_id")
+    if rid is not None:
+        if not isinstance(rid, str) or not rid:
+            raise ValueError("request_id must be a non-empty string "
+                             "when present")
+        kwargs["request_id"] = rid
+    gateway = body.get("gateway")
+    if gateway is not None:
+        if not isinstance(gateway, dict):
+            raise ValueError("gateway must be an object when present")
+        kwargs["gateway"] = {
+            "attempt": int(gateway.get("attempt", 1)),
+            "replay": bool(gateway.get("replay")),
+            "hedge": bool(gateway.get("hedge")),
+        }
     return ServeRequest(input_ids=ids, gen=GenerationConfig(**gen_kw),
                         seed=int(body.get("seed", 0)), tenant=tenant or None,
-                        trace=TraceContext.from_traceparent(traceparent))
+                        trace=TraceContext.from_traceparent(traceparent),
+                        **kwargs)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -151,10 +176,14 @@ class _Handler(BaseHTTPRequestHandler):
                       "trace_id": trace_id},
                 headers=self._trace_headers(request))
         except EngineShutdown as e:  # process exiting: go to another replica
+            # 503 + Retry-After, drain-time derived like the degraded 429:
+            # "come back after the relaunch", not "hot-retry a dying pod"
+            retry = max(1, int(-(-getattr(e, "retry_after_s", 1.0) // 1)))
             return self._send_json(
                 503, {"error": str(e), "request_id": request.request_id,
                       "trace_id": trace_id},
-                headers=self._trace_headers(request))
+                headers=self._trace_headers(request,
+                                            {"Retry-After": str(retry)}))
 
         if not body.get("stream"):
             try:

@@ -100,7 +100,7 @@ class RequestTraceBuilder:
                  "pages_reserved", "pages_allocated", "first_tick",
                  "last_tick", "ticks", "shared_with", "t_admit", "t_first",
                  "abandoned_at", "prefix_tokens", "prefix_pages",
-                 "prefix_cow")
+                 "prefix_cow", "gateway")
 
     def __init__(self, request) -> None:
         ctx = request.trace
@@ -126,6 +126,10 @@ class RequestTraceBuilder:
         self.prefix_tokens = 0     # padded-row positions served from cache
         self.prefix_pages = 0      # shared pages mapped at admission
         self.prefix_cow = False    # divergence mid-page: a CoW fork ran
+        # gateway dispatch attribution ({"attempt", "replay", "hedge"},
+        # serve/gateway.py): present only on routed requests, absent on
+        # the direct-to-replica path so those records stay byte-identical
+        self.gateway = getattr(request, "gateway", None)
 
     # -- lifecycle events (engine loop thread) -----------------------------
 
@@ -234,6 +238,8 @@ class RequestTraceBuilder:
                              "ticks": self.ticks,
                              "shared_with": {str(k): v for k, v in
                                              sorted(self.shared_with.items())}}
+        if self.gateway:
+            rec["gateway"] = self.gateway
         if self.prefix_tokens:
             rec["prefix_cached_tokens"] = self.prefix_tokens
             rec["prefix_shared_pages"] = self.prefix_pages

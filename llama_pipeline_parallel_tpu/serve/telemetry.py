@@ -316,3 +316,88 @@ class SLOStats:
                 out["tenants"] = {name: ts.snapshot() for name, ts in
                                   sorted(self._tenants.items())}
             return out
+
+
+# ---------------------------------------------------------------------------
+# gateway-tier accounting (serve/gateway.py)
+# ---------------------------------------------------------------------------
+
+# cumulative gateway counters, ONE spelling shared by the gateway /healthz
+# snapshot, its metrics.jsonl lines, the fleet rollup
+# (utils/fleet._GATEWAY_FIELDS) and tools/fleet_report.py — the serving
+# SERVE_COUNTER_KEYS rule applied to the routing tier
+GATEWAY_COUNTER_KEYS = (
+    "requests_routed",       # dispatch attempts sent to replicas (incl.
+    #                          replays and hedges)
+    "requests_retried",      # attempts re-routed after a 429/503 backoff
+    "requests_replayed",     # requests re-submitted after a replica died
+    #                          with tokens already delivered (splice path)
+    "requests_hedged",       # hedge attempts launched (tail-latency race)
+    "hedge_wins",            # requests whose hedge delivered first
+    "wasted_hedge_tokens",   # tokens streamed by a losing attempt after
+    #                          the winner was chosen (pure overhead gauge)
+    "replay_skipped_tokens", # replayed-stream tokens suppressed below the
+    #                          delivered watermark (splice verification)
+    "requests_completed",
+    "requests_failed",       # terminal failure after the retry budget
+    "requests_shed",         # no healthy replica / upstream backoff budget
+    "requests_rejected",     # replica said 400: deterministic, not retried
+    "requests_abandoned",    # client hung up mid-stream
+)
+
+# gateway percentile window: the hedge delay is derived from CURRENT tail
+# latency, so the window must roll like the per-tenant ones do
+GATEWAY_WINDOW = 512
+
+
+class GatewayStats:
+    """Thread-safe gateway-tier accounting: cumulative GATEWAY_COUNTER_KEYS
+    counters, a per-replica inflight gauge (the routing tier's own load
+    signal — requests IT has outstanding on each replica, distinct from the
+    replica's queue depth), and a rolling TTFT window the p95-derived hedge
+    delay reads. Mirrors SLOStats' shape so /healthz, metrics.jsonl and the
+    fleet rollup consume one snapshot dict."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters = {key: 0 for key in GATEWAY_COUNTER_KEYS}
+        self._inflight: dict[str, int] = {}
+        self._ttft = collections.deque(maxlen=GATEWAY_WINDOW)
+
+    def bump(self, key: str, n: int = 1) -> None:
+        if key not in self._counters:
+            raise KeyError(f"unknown gateway counter {key!r} "
+                           f"(use one of {GATEWAY_COUNTER_KEYS})")
+        with self._lock:
+            self._counters[key] += n
+
+    def inflight(self, replica: str, delta: int) -> None:
+        with self._lock:
+            self._inflight[replica] = self._inflight.get(replica, 0) + delta
+
+    def record_ttft(self, ttft_s: float) -> None:
+        with self._lock:
+            self._ttft.append(ttft_s)
+
+    def ttft_p95_s(self, min_samples: int = 20) -> float | None:
+        """The hedge-delay input: rolling client-visible TTFT p95, None
+        until `min_samples` requests have completed — hedging must not
+        actuate on a cold, unrepresentative window."""
+        with self._lock:
+            if len(self._ttft) < min_samples:
+                return None
+            return percentile(list(self._ttft), 95)
+
+    def snapshot(self) -> dict:
+        """One flat dict, `"gateway": 1` marking the stream the way
+        serving lines carry `"serving": 1` — the fleet tailer keys its
+        rollup branch on it."""
+        with self._lock:
+            out: dict = {"gateway": 1}
+            out.update(self._counters)
+            out.update(percentiles_ms(list(self._ttft), "ttft"))
+            inflight = {k: v for k, v in sorted(self._inflight.items()) if v}
+            out["inflight_total"] = sum(inflight.values())
+            if inflight:
+                out["inflight"] = inflight
+            return out

@@ -90,7 +90,7 @@ ENV_PLAN = "LPT_FAULT_PLAN"
 _OPS = ("error", "stall", "slow", "corrupt", "die", "grad_nonfinite",
         "device_loss", "oom")
 _SITES = ("storage_write", "ckpt_commit", "barrier", "data_read", "step",
-          "device_probe", "action_execute")
+          "device_probe", "action_execute", "gateway_dispatch")
 
 
 class InjectedFault(OSError):

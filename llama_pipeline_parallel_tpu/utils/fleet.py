@@ -460,6 +460,15 @@ _SERVE_FIELDS = ("requests_completed", "requests_rejected", "requests_failed",
                  "prefix_hit_rate", "prefix_cached_tokens",
                  "prefix_shared_pages", "prefix_cow_forks", "pages_cached",
                  "prefix_evictions")
+# gateway metrics-line fields the rollup keeps (serve/gateway.py marks its
+# lines `"gateway": 1` the way replicas mark theirs `"serving": 1`)
+_GATEWAY_FIELDS = ("requests_routed", "requests_retried", "requests_replayed",
+                   "requests_hedged", "hedge_wins", "wasted_hedge_tokens",
+                   "replay_skipped_tokens", "requests_completed",
+                   "requests_failed", "requests_shed", "requests_rejected",
+                   "requests_abandoned", "ttft_p50_ms", "ttft_p95_ms",
+                   "inflight_total", "replicas_known", "replicas_healthy",
+                   "draining")
 _STEP_TIME_WINDOW = 64
 
 
@@ -485,6 +494,7 @@ class _MemberTail:
             if tail_streams else None)
         self.train_last: dict = {}
         self.serve_last: dict = {}
+        self.gateway_last: dict = {}
         self.step_times: list[float] = []
         self.inc_count = 0
         self.inc_failed = 0
@@ -506,6 +516,10 @@ class _MemberTail:
                 for k in _SERVE_FIELDS:
                     if k in m:
                         self.serve_last[k] = m[k]
+            elif m.get("gateway"):
+                for k in _GATEWAY_FIELDS:
+                    if k in m:
+                        self.gateway_last[k] = m[k]
             else:
                 for k in _TRAIN_FIELDS:
                     if k in m:
@@ -641,6 +655,8 @@ class FleetAggregator:
                 status[out_key] = val
         if tail.serve_last:
             status.update(tail.serve_last)
+        if tail.gateway_last:
+            status.update(tail.gateway_last)
         if health.get("checkpoint_step") is not None:
             status["checkpoint_step"] = health.get("checkpoint_step")
         elif isinstance(reg.get("checkpoint_step"), int):

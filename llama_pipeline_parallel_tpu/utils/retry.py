@@ -73,6 +73,17 @@ class RetryPolicy:
         return base * rng.uniform(1.0 - self.jitter, 1.0 + self.jitter)
 
 
+def backoff_delay_s(policy: RetryPolicy, attempt: int, rng: random.Random,
+                    floor_s: float = 0.0) -> float:
+    """The backoff before try `attempt + 1` with a server-supplied floor:
+    when the upstream answered 429/503 with a Retry-After hint, honoring
+    it means never retrying EARLIER than the hint — the policy's
+    exponential curve still applies on top, so repeated hints cannot pin
+    a client into a hot loop at the server's minimum (the serve/gateway.py
+    dispatch rule, spelled here next to the curve it composes with)."""
+    return max(policy.delay_s(attempt, rng), floor_s)
+
+
 def _rng(seed: int | None) -> random.Random:
     if seed is None:
         raw = os.environ.get("LPT_RETRY_SEED")

@@ -284,6 +284,9 @@ def test_warm_hits_bit_equal_cold_engine_and_generate(setup):
     cold.shutdown()
 
 
+@pytest.mark.slow  # funds the Gateway tier-1 rows: the fp32 warm-hit
+# bit-equality test covers the cold-vs-warm contract every tier-1 run;
+# this row re-proves it under bf16 (a second full compile) nightly
 def test_warm_hit_bit_equal_bf16(setup):
     cfg_b = LlamaConfig.tiny(dtype=jnp.bfloat16)
     params_b = llama.init_params(jax.random.PRNGKey(0), cfg_b)
@@ -466,6 +469,9 @@ def test_abandoned_requests_release_pages_at_next_tick(setup):
 # -- the measured win ----------------------------------------------------------
 
 
+@pytest.mark.slow  # funds the Gateway tier-1 rows: the hit-rate win is
+# already pinned by the unit-level reuse tests; this 12-request Poisson
+# grid row is the nightly end-to-end re-proof
 def test_shared_mix_trace_hits_every_hot_request(setup):
     cfg_big = LlamaConfig.tiny(max_position_embeddings=256)
     _, params = setup

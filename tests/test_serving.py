@@ -646,3 +646,33 @@ def test_degraded_maps_to_429_with_pinned_retry_after(setup):
         engine.clear_degraded()
         engine.drain(timeout_s=120)  # the queued admissions still finish
         server.shutdown()
+
+
+def test_shutdown_maps_to_503_with_pinned_retry_after(setup):
+    """HTTP contract pin for the gateway's failover signal: a shut-down
+    replica answers 503 + Retry-After so the gateway reroutes instead of
+    hot-retrying a dying process. With no measured completions the hint
+    is the 1.0 s fallback, and "rid-301" has zero deterministic jitter
+    (crc32 % 1000 == 0) — the header is exactly "1"."""
+    from llama_pipeline_parallel_tpu.serve.frontend import make_server
+
+    cfg, params = setup
+    engine = make_engine(cfg, params)
+    server = make_server(engine)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        engine.shutdown()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/generate",
+                data=json.dumps({"input_ids": [3, 4], "max_new_tokens": 2,
+                                 "request_id": "rid-301"}).encode(),
+                headers={"Content-Type": "application/json"}), timeout=60)
+        assert err.value.code == 503
+        assert err.value.headers["Retry-After"] == "1"
+        payload = json.loads(err.value.read())
+        assert "shut down" in payload["error"]
+        assert payload["request_id"] == "rid-301"
+    finally:
+        server.shutdown()

@@ -92,9 +92,18 @@ def build_report(fleet_root: str) -> dict:
     t0 = min(t0_candidates) if t0_candidates else None
 
     # serve SLO + checkpoint-lag tables straight off the member rollups
-    slo_rows, lag_rows = [], []
+    slo_rows, lag_rows, gateway_rows = [], [], []
     trainer_step = status.get("pod", {}).get("trainer_step")
     for member_id, member in status["members"].items():
+        if member["role"] == "gateway":
+            # the routing tier's own counters (serve/gateway.py): routing /
+            # retry / replay / hedge volume plus gateway-observed TTFT
+            gateway_rows.append({k: member.get(k) for k in (
+                "replica", "requests_routed", "requests_completed",
+                "requests_retried", "requests_replayed", "requests_hedged",
+                "hedge_wins", "wasted_hedge_tokens", "replay_skipped_tokens",
+                "requests_shed", "requests_failed", "ttft_p50_ms",
+                "ttft_p95_ms", "replicas_healthy", "replicas_known")})
         if member["role"] != "serve":
             continue
         slo_rows.append({k: member.get(k) for k in (
@@ -111,7 +120,7 @@ def build_report(fleet_root: str) -> dict:
             "members": status["members"], "pod": status.get("pod", {}),
             "incarnation_timeline": events, "alert_timeline": alerts,
             "action_timeline": actions,
-            "slo_table": slo_rows,
+            "slo_table": slo_rows, "gateway_table": gateway_rows,
             "checkpoint_lag": {"trainer_step": trainer_step,
                                "replicas": lag_rows}}
 
@@ -205,6 +214,21 @@ def print_report(rep: dict) -> None:
                 "requests_page_refused", "requests_failed")
                 if r.get(k) is not None)
             cells = cells or "(no serving metrics recorded)"
+            print(f"  {str(r.get('replica')):<16} {cells}")
+
+    if rep.get("gateway_table"):
+        print("\n== gateway tier (last metrics line per gateway) ==")
+        for r in rep["gateway_table"]:
+            cells = " ".join(f"{k}={r[k]}" for k in (
+                "requests_routed", "requests_completed", "requests_retried",
+                "requests_replayed", "requests_hedged", "hedge_wins",
+                "wasted_hedge_tokens", "replay_skipped_tokens",
+                "requests_shed", "requests_failed", "ttft_p50_ms",
+                "ttft_p95_ms") if r.get(k) is not None)
+            if r.get("replicas_known") is not None:
+                cells += (f" replicas={r.get('replicas_healthy')}"
+                          f"/{r.get('replicas_known')} healthy")
+            cells = cells.strip() or "(no gateway metrics recorded)"
             print(f"  {str(r.get('replica')):<16} {cells}")
 
     lag = rep["checkpoint_lag"]

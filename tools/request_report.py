@@ -13,10 +13,17 @@ snapshot) — and answers "which request paid the p99 and WHERE":
   queue wait, the request's OWN prefill chunks, and the gap between them
   (time spent waiting behind a chunking neighbor's prefill ticks);
 - per-tenant tables (counts, tokens, TTFT/TPOT percentiles) when the
-  trace carries tenants.
+  trace carries tenants;
+- with `--gateway DIR`, the gateway-tier join: the routing tier's WAL
+  (`gateway_journal.jsonl`, serve/gateway.py) joined to the replica's
+  trace records by trace_id — one request's full dispatch history
+  (every routed attempt, the replay after a replica died, the hedge that
+  lost) next to the replica-side spans it produced, plus replay/hedge
+  spans in the exemplar waterfall.
 
     python tools/request_report.py /runs/serve1
     python tools/request_report.py /runs/serve1 --json
+    python tools/request_report.py /runs/serve1 --gateway /runs/gw
 
 Degrades instead of tracebacking on missing/torn files (the
 goodput_report.py contract): a crashed replica's directory must still
@@ -60,6 +67,125 @@ def load_exemplars(output_dir: str) -> dict:
 
 def _num(v) -> float | None:
     return float(v) if isinstance(v, (int, float)) else None
+
+
+def load_gateway_journal(gateway_dir: str) -> dict[str, dict]:
+    """gid -> collapsed WAL state from a gateway dir's
+    gateway_journal.jsonl (serve/gateway.py schema): intent ts + trace_id,
+    every routed attempt, the high-water delivered mark, and the FIRST
+    terminal row (the journal writer enforces exactly one; a torn rewrite
+    never un-decides an outcome). perf.read_jsonl keeps this tolerant of
+    torn tails — a crashed gateway's journal still reports."""
+    from llama_pipeline_parallel_tpu.utils.perf import read_jsonl
+
+    from llama_pipeline_parallel_tpu.serve.gateway import JOURNAL_NAME
+
+    by_gid: dict[str, dict] = {}
+    for r in read_jsonl(os.path.join(gateway_dir, JOURNAL_NAME)):
+        gid, kind = r.get("gid"), r.get("kind")
+        if not isinstance(gid, str) or not isinstance(kind, str):
+            continue
+        st = by_gid.setdefault(gid, {
+            "gid": gid, "trace_id": None, "intent_ts": None,
+            "routed": [], "watermark": 0, "terminal": None})
+        if kind == "intent":
+            st["trace_id"] = r.get("trace_id")
+            st["intent_ts"] = _num(r.get("ts"))
+        elif kind == "routed":
+            st["routed"].append({k: r.get(k) for k in
+                                 ("replica", "attempt", "hedge", "ts")})
+        elif kind == "watermark":
+            st["watermark"] = max(st["watermark"],
+                                  int(r.get("delivered") or 0))
+        elif kind == "terminal" and st["terminal"] is None:
+            st["terminal"] = {k: r.get(k) for k in
+                              ("outcome", "tokens", "ts", "replays",
+                               "hedges", "via") if r.get(k) is not None}
+    return by_gid
+
+
+def gateway_tables(by_gid: dict[str, dict],
+                   records: list[dict]) -> dict:
+    """The gateway join: WAL state keyed by gid, replica trace records
+    attached by trace_id (a replayed request has ONE gid and trace_id but
+    several replica records — the dead attempt's partial trace and the
+    survivor's full one both join)."""
+    recs_by_trace: dict[str, list[dict]] = {}
+    for rec in records:
+        tid = rec.get("trace_id")
+        if isinstance(tid, str):
+            recs_by_trace.setdefault(tid, []).append(rec)
+    outcomes: dict[str, int] = {}
+    replayed = hedged = orphans = joined = 0
+    exemplar = None
+    rows = []
+    for gid in sorted(by_gid):
+        st = by_gid[gid]
+        term = st["terminal"]
+        if term is None:
+            orphans += 1
+        else:
+            outcomes[term["outcome"]] = outcomes.get(term["outcome"], 0) + 1
+            if term.get("replays"):
+                replayed += 1
+            if term.get("hedges"):
+                hedged += 1
+        replica_recs = recs_by_trace.get(st["trace_id"], [])
+        joined += bool(replica_recs)
+        row = {**st, "replica_records": len(replica_recs),
+               "replicas": sorted({r.get("replica") for r in st["routed"]
+                                   if r.get("replica")})}
+        rows.append(row)
+        # the exemplar: the request with the busiest dispatch history
+        # (most attempts; replays beat hedges at a tie) — the one whose
+        # waterfall shows the failover machinery actually working
+        busy = (len(st["routed"]),
+                int((term or {}).get("replays") or 0))
+        if st["routed"] and (exemplar is None or busy > exemplar[0]):
+            exemplar = (busy, row, replica_recs)
+    return {"requests": len(by_gid), "outcomes": dict(sorted(
+                outcomes.items())),
+            "replayed": replayed, "hedged": hedged, "orphans": orphans,
+            "joined": joined, "rows": rows,
+            "exemplar": None if exemplar is None
+            else {"wal": exemplar[1], "records": exemplar[2]}}
+
+
+def gateway_waterfall(wal: dict, replica_recs: list[dict]) -> list[str]:
+    """Render one gateway request's dispatch history: each routed attempt
+    as a span at its offset from the WAL intent row, replay/hedge marked,
+    with the replica-side record (outcome + TTFT) it joins to."""
+    t0 = wal.get("intent_ts")
+    term = wal.get("terminal") or {}
+    lines = [f"  gateway {wal['gid']} trace {wal.get('trace_id')} "
+             f"outcome={term.get('outcome', 'ORPHANED')} "
+             f"tokens={term.get('tokens')} watermark={wal.get('watermark')}"]
+    by_attempt = {}
+    for rec in replica_recs:
+        att = (rec.get("gateway") or {}).get("attempt")
+        if att is not None:
+            by_attempt.setdefault(att, rec)
+    for r in wal["routed"]:
+        ts = _num(r.get("ts"))
+        off = (f"+{1000 * (ts - t0):8.1f} ms"
+               if ts is not None and t0 is not None else "        ?")
+        kind = "hedge " if r.get("hedge") else ("replay" if r["attempt"] > 1
+                                                else "route ")
+        rec = by_attempt.get(r.get("attempt"))
+        side = ""
+        if rec is not None:
+            ttft = _num(rec.get("ttft_s"))
+            side = (f"  -> replica outcome={rec.get('outcome')}"
+                    + (f" ttft={1000 * ttft:.1f} ms" if ttft else ""))
+        lines.append(f"    {off}  attempt {r.get('attempt')} "
+                     f"{kind} -> {r.get('replica')}{side}")
+    ts = _num(term.get("ts"))
+    if ts is not None and t0 is not None:
+        extras = " ".join(f"{k}={term[k]}" for k in
+                          ("replays", "hedges", "via") if k in term)
+        lines.append(f"    +{1000 * (ts - t0):8.1f} ms  terminal "
+                     f"{term.get('outcome')} {extras}".rstrip())
+    return lines
 
 
 def ttft_breakdown(rec: dict) -> dict | None:
@@ -156,6 +282,14 @@ def exemplar_waterfall(rec: dict) -> list[str]:
     lines = [f"  request {rec.get('request_id')} trace {rec.get('trace_id')}"
              f" tenant={rec.get('tenant')} outcome={rec.get('outcome')}"
              f" tokens={rec.get('tokens')}"]
+    gw = rec.get("gateway")
+    if isinstance(gw, dict):
+        # gateway attribution (serve/gateway.py pass-through): which
+        # dispatch attempt produced THIS replica-side record
+        lines.append(
+            f"  gateway attempt {gw.get('attempt')}"
+            + (" (replay)" if gw.get("replay") else "")
+            + (" (hedge)" if gw.get("hedge") else ""))
     bd = ttft_breakdown(rec)
     if bd:
         lines.append(
@@ -193,9 +327,11 @@ def exemplar_waterfall(rec: dict) -> list[str]:
     return lines
 
 
-def build_report(output_dir: str) -> dict:
+def build_report(output_dir: str, gateway_dir: str | None = None) -> dict:
     records = load_trace(output_dir)
     exemplars = load_exemplars(output_dir)
+    gateway = (gateway_tables(load_gateway_journal(gateway_dir), records)
+               if gateway_dir else None)
     completed = [r for r in records if r.get("outcome") == "completed"]
     shed = [r for r in records if r.get("outcome") == "shed"]
     ttft = [v for r in completed
@@ -216,6 +352,7 @@ def build_report(output_dir: str) -> dict:
         "cow_forks": sum(1 for r in hits if r.get("prefix_cow_fork")),
     } if hits else None
     return {"output_dir": output_dir,
+            "gateway": gateway,
             "prefix": prefix,
             "records": len(records),
             "completed": len(completed),
@@ -237,10 +374,14 @@ def build_report(output_dir: str) -> dict:
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("output_dir")
+    p.add_argument("--gateway", default=None, metavar="DIR",
+                   help="gateway output dir: join its "
+                        "gateway_journal.jsonl to the replica trace by "
+                        "trace_id (dispatch attempts, replays, hedges)")
     p.add_argument("--json", action="store_true",
                    help="emit the full report as one JSON object")
     args = p.parse_args(argv)
-    rep = build_report(args.output_dir)
+    rep = build_report(args.output_dir, gateway_dir=args.gateway)
     if args.json:
         print(json.dumps(rep, indent=2))
         return 0 if rep["records"] else 1
@@ -275,6 +416,21 @@ def main(argv: list[str] | None = None) -> int:
         print("\n== slowest-TTFT exemplar waterfall ==")
         for line in exemplar_waterfall(rep["p99_exemplar"]):
             print(line)
+    gw = rep.get("gateway")
+    if gw:
+        print(f"\n== gateway join ({gw['requests']} journalled "
+              f"request(s)) ==")
+        cells = " ".join(f"{k}={v}" for k, v in gw["outcomes"].items())
+        print(f"  outcomes: {cells or '(none terminal)'}; "
+              f"{gw['replayed']} replayed, {gw['hedged']} hedged, "
+              f"{gw['orphans']} orphaned")
+        print(f"  {gw['joined']} of {gw['requests']} joined to replica "
+              f"trace records by trace_id")
+        if gw["exemplar"]:
+            print("\n== busiest dispatch waterfall (most attempts) ==")
+            for line in gateway_waterfall(gw["exemplar"]["wal"],
+                                          gw["exemplar"]["records"]):
+                print(line)
     if rep["tenants"]:
         print("\n== per-tenant ==")
         for name, row in rep["tenants"].items():

@@ -20,16 +20,30 @@ metadata is exactly what generated its load.
 Determinism: the trace depends only on (seed, rate, n, mixes) — two runs
 against the same checkpoint see identical arrivals, prompts, and sampling
 seeds. Wall-clock replay obviously isn't deterministic; the trace is.
+
+`--gateway URL` replays the SAME trace over HTTP through the routing tier
+(tools/gateway.py) instead of an in-process engine — no checkpoint load,
+no jax in this process — and the summary gains the gateway's per-request
+attempt/replay/hedge counts. `--chaos kill:<t_s>` pairs with it: SIGKILL
+the replica named by `--chaos_target` (its serve.json pid) at trace
+offset t_s, turning the run into the failover acceptance drill — the
+summary then shows how many requests were replayed to a survivor.
+Gateway mode adds NO RNG draws: arrivals, prompts, and seeds come from
+the identical `poisson_trace` stream, so a gateway run and an in-process
+run of the same (seed, rate, n, mixes) serve identical requests.
 """
 
 from __future__ import annotations
 
 import argparse
 import dataclasses
+import http.client
 import json
 import os
 import re
+import signal
 import sys
+import threading
 import time
 import zlib
 
@@ -302,10 +316,195 @@ def run_trace(engine, trace_requests, time_scale: float = 1.0,
     return summary
 
 
+def parse_chaos(spec: str) -> tuple[str, float]:
+    """`"kill:2.5"` -> ("kill", 2.5): SIGKILL the --chaos_target replica
+    at trace offset 2.5s (scaled by --time_scale like arrivals)."""
+    kind, _, at = spec.partition(":")
+    if kind != "kill" or not at:
+        raise ValueError(f"chaos spec {spec!r}: expected 'kill:<t_s>'")
+    t_s = float(at)
+    if t_s < 0:
+        raise ValueError(f"chaos offset must be >= 0, got {t_s}")
+    return kind, t_s
+
+
+def kill_replica(replica_dir: str) -> int | None:
+    """SIGKILL the serve process whose serve.json lives in `replica_dir`;
+    returns the pid killed, or None when there is nothing to kill (the
+    chaos drill racing a supervisor relaunch is expected, not an error)."""
+    try:
+        with open(os.path.join(replica_dir, "serve.json")) as f:
+            pid = int(json.load(f)["pid"])
+        os.kill(pid, signal.SIGKILL)
+        return pid
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def _gateway_addr(url: str) -> tuple[str, int]:
+    hostport = url.split("//", 1)[-1].rstrip("/")
+    host, _, port = hostport.partition(":")
+    return host or "127.0.0.1", int(port or 80)
+
+
+def _gateway_one(host: str, port: int, body: dict, timeout_s: float,
+                 results: list, i: int) -> None:
+    """One streamed request through the gateway; results[i] gets
+    {"status", "tokens", "attempts", "replays", "hedges"} or
+    {"status", "error"} — connection death (the gateway itself dying,
+    not a replica: replica deaths are absorbed by replay) is an error."""
+    out: dict = {"status": 0}
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+        conn.request("POST", "/v1/generate", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out["status"] = resp.status
+        if resp.status != 200:
+            try:
+                out["error"] = json.loads(resp.read() or b"{}").get("error")
+            except ValueError:
+                out["error"] = f"http {resp.status}"
+            return
+        tokens, tail = [], None
+        while True:
+            raw = resp.readline()
+            if not raw:
+                break
+            line = json.loads(raw)
+            if line.get("done"):
+                tail = line
+                break
+            tokens.append(line["token"])
+        if tail is None:
+            out.update(status=0, error="stream ended without done line")
+            return
+        if "error" in tail:
+            out.update(status=500, error=tail["error"])
+            return
+        out.update(tokens=tail.get("tokens", tokens),
+                   attempts=int(tail.get("attempts", 1)),
+                   replays=int(tail.get("replays", 0)),
+                   hedges=int(tail.get("hedges", 0)))
+    except (OSError, ValueError) as e:
+        out.setdefault("error", repr(e))
+        out["status"] = out.get("status") or 0
+    finally:
+        results[i] = out
+
+
+def gateway_healthz(gateway_url: str, timeout_s: float = 5.0) -> dict:
+    host, port = _gateway_addr(gateway_url)
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    conn.request("GET", "/healthz")
+    return json.loads(conn.getresponse().read())
+
+
+def run_trace_gateway(gateway_url: str, trace_requests, vocab: int,
+                      time_scale: float = 1.0, prompt_token_low: int = 3,
+                      result_timeout_s: float = 300.0,
+                      collect_tokens: bool = False,
+                      chaos: tuple[str, float] | None = None,
+                      chaos_target: str | None = None) -> dict:
+    """Replay a trace through the gateway tier over HTTP: one streaming
+    POST per request at its (scaled) arrival offset, each read to its
+    done line on a worker thread. Prompts are drawn exactly as
+    `run_trace` draws them — same RandomState(seed) stream — so the two
+    modes serve identical requests. `chaos=("kill", t_s)` SIGKILLs the
+    `chaos_target` replica at trace offset t_s; requests in flight on it
+    are the gateway's replay population, and the summary's `replayed` /
+    `attempts_total` report what the failover actually did."""
+    host, port = _gateway_addr(gateway_url)
+    n = len(trace_requests)
+    results: list = [None] * n
+    threads: list[threading.Thread] = []
+    t0 = time.monotonic()
+    chaos_timer = None
+    if chaos is not None:
+        if not chaos_target:
+            raise ValueError("chaos needs a chaos_target replica dir")
+        kind, t_s = chaos
+        chaos_timer = threading.Timer(t_s * time_scale, kill_replica,
+                                      args=(chaos_target,))
+        chaos_timer.daemon = True
+        chaos_timer.start()
+    for i, tr in enumerate(trace_requests):
+        target = t0 + tr.arrival_s * time_scale
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        prompt = np.random.RandomState(tr.seed).randint(
+            prompt_token_low, vocab, size=tr.prompt_len).tolist()
+        if tr.prefix_len:
+            prompt = prefix_ids(tr.prefix, tr.prefix_len, vocab,
+                                prompt_token_low) + prompt
+        body = {"input_ids": prompt, "seed": tr.seed, "stream": True,
+                "max_new_tokens": tr.max_new_tokens}
+        if tr.tenant:
+            body["tenant"] = tr.tenant
+        t = threading.Thread(target=_gateway_one,
+                             args=(host, port, body, result_timeout_s,
+                                   results, i), daemon=True)
+        t.start()
+        threads.append(t)
+    deadline = time.monotonic() + result_timeout_s
+    for t in threads:
+        t.join(timeout=max(0.1, deadline - time.monotonic()))
+    if chaos_timer is not None:
+        chaos_timer.cancel()
+    wall = time.monotonic() - t0
+    done = [r or {"status": 0, "error": "no response"} for r in results]
+    completed = [r for r in done if r["status"] == 200 and "error" not in r]
+    summary = {
+        "requests": n,
+        "submitted": sum(1 for r in done if r["status"] == 200),
+        "completed": len(completed),
+        "failed": sum(1 for r in done
+                      if r["status"] in (0, 500)
+                      or (r["status"] == 200 and "error" in r)),
+        "refused_overload": sum(1 for r in done
+                                if r["status"] in (429, 503)),
+        "rejected_shape": sum(1 for r in done if r["status"] == 400),
+        "attempts_total": sum(r.get("attempts", 0) for r in completed),
+        "replayed": sum(1 for r in completed if r.get("replays", 0) > 0),
+        "hedged": sum(1 for r in completed if r.get("hedges", 0) > 0),
+        "wall_s": round(wall, 3),
+    }
+    try:
+        snap = gateway_healthz(gateway_url)
+        summary["gateway"] = {k: snap[k] for k in (
+            "requests_routed", "requests_retried", "requests_replayed",
+            "requests_hedged", "hedge_wins", "wasted_hedge_tokens",
+            "replay_skipped_tokens", "requests_completed",
+            "requests_failed", "requests_shed", "ttft_p50_ms",
+            "ttft_p95_ms", "replicas_known", "replicas_healthy")
+            if k in snap}
+    except (OSError, ValueError):
+        pass  # gateway gone at drain time: the per-request view stands
+    if collect_tokens:
+        summary["tokens"] = [r.get("tokens") for r in done]
+    return summary
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--platform", default=None)
-    p.add_argument("--checkpoint_dir", required=True)
+    p.add_argument("--checkpoint_dir", default=None,
+                   help="required unless --gateway drives a remote tier")
+    p.add_argument("--gateway", default=None, metavar="URL",
+                   help="replay the trace over HTTP through a gateway "
+                        "(tools/gateway.py) instead of an in-process "
+                        "engine — no checkpoint load in this process")
+    p.add_argument("--vocab", type=int, default=32000,
+                   help="vocab size for prompt draws in --gateway mode "
+                        "(in-process mode reads it off the checkpoint)")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="failure drill in --gateway mode: 'kill:<t_s>' "
+                        "SIGKILLs the --chaos_target replica at trace "
+                        "offset t_s (scaled by --time_scale)")
+    p.add_argument("--chaos_target", default=None,
+                   help="replica output dir whose serve.json pid the "
+                        "--chaos drill kills")
     p.add_argument("--step", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--rate", type=float, default=4.0, help="requests/s")
@@ -347,6 +546,39 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--prefill_chunk_tokens", type=int, default=0)
     args = p.parse_args(argv)
 
+    prompt_mix = parse_mix(args.prompt_mix)
+    output_mix = parse_mix(args.output_mix)
+    tenant_mix = (parse_tenant_mix(args.tenant_mix)
+                  if args.tenant_mix else None)
+    prefix_mix = (parse_prefix_mix(args.prefix_mix)
+                  if args.prefix_mix else None)
+    if args.request_trace and not args.output_dir:
+        p.error("--request_trace requires --output_dir")
+    if args.chaos and not args.gateway:
+        p.error("--chaos is a --gateway mode drill")
+    if args.chaos and not args.chaos_target:
+        p.error("--chaos requires --chaos_target")
+
+    if args.gateway:
+        # gateway mode: same trace, over HTTP — this process never
+        # touches jax or the checkpoint
+        trace_requests = poisson_trace(args.seed, args.rate, args.requests,
+                                       prompt_mix, output_mix,
+                                       tenant_mix=tenant_mix,
+                                       prefix_mix=prefix_mix)
+        summary = run_trace_gateway(
+            args.gateway, trace_requests, vocab=args.vocab,
+            time_scale=args.time_scale,
+            chaos=parse_chaos(args.chaos) if args.chaos else None,
+            chaos_target=args.chaos_target)
+        summary["mix"] = {"prompt": mix_label(prompt_mix),
+                          "output": mix_label(output_mix),
+                          "rate_rps": args.rate, "seed": args.seed}
+        print(json.dumps(summary, indent=2))
+        return 0
+
+    if not args.checkpoint_dir:
+        p.error("--checkpoint_dir is required without --gateway")
     if args.platform:
         import jax
 
@@ -357,14 +589,6 @@ def main(argv: list[str] | None = None) -> int:
     )
     from llama_pipeline_parallel_tpu.serve import ServeConfig, ServeEngine
 
-    prompt_mix = parse_mix(args.prompt_mix)
-    output_mix = parse_mix(args.output_mix)
-    tenant_mix = (parse_tenant_mix(args.tenant_mix)
-                  if args.tenant_mix else None)
-    prefix_mix = (parse_prefix_mix(args.prefix_mix)
-                  if args.prefix_mix else None)
-    if args.request_trace and not args.output_dir:
-        p.error("--request_trace requires --output_dir")
     params, cfg, _, step = load_module_checkpoint(args.checkpoint_dir,
                                                   args.step)
     reqtrace_rec = None
